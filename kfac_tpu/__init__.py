@@ -1,0 +1,36 @@
+"""kfac_tpu: TPU-native K-FAC / KAISA second-order preconditioning for JAX.
+
+A from-scratch JAX/XLA framework with the capabilities of the reference
+K-FAC implementation surveyed in SURVEY.md: Kronecker-factored curvature
+preconditioning (eigen + inverse methods), KAISA-style distributed work
+placement over device meshes, hyperparameter schedules, tracing, and
+checkpointing — built on pjit/shard_map collectives instead of
+torch.distributed.
+"""
+
+from kfac_tpu import enums
+from kfac_tpu.enums import (
+    AllreduceMethod,
+    AssignmentStrategy,
+    ComputeMethod,
+    DistributedStrategy,
+)
+from kfac_tpu.layers.capture import CapturedStats, CurvatureCapture
+from kfac_tpu.layers.registry import Registry, register_model
+from kfac_tpu.preconditioner import KFACPreconditioner, KFACState
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'AllreduceMethod',
+    'AssignmentStrategy',
+    'CapturedStats',
+    'ComputeMethod',
+    'CurvatureCapture',
+    'DistributedStrategy',
+    'KFACPreconditioner',
+    'KFACState',
+    'Registry',
+    'enums',
+    'register_model',
+]

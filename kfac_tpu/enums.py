@@ -1,0 +1,64 @@
+"""Enum types for the K-FAC TPU framework.
+
+Capability parity with the reference enums (see
+/root/reference/kfac/enums.py:8-54) expressed for a JAX/XLA execution model:
+``DistributedStrategy`` selects how second-order state is laid out over the
+mesh rather than which NCCL groups get built.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class AllreduceMethod(enum.Enum):
+    """How factor all-reduces are issued.
+
+    On TPU, XLA fuses independent collectives on its own, so ``ALLREDUCE``
+    (one psum per factor, fused by the compiler) is the default.
+    ``ALLREDUCE_BUCKETED`` packs all factors into one flat buffer first —
+    useful over DCN where fewer, larger collectives win.
+    """
+
+    ALLREDUCE = 1
+    ALLREDUCE_BUCKETED = 2
+
+
+class AssignmentStrategy(enum.Enum):
+    """Cost model used to load-balance factor inverse work across devices.
+
+    COMPUTE weights a factor by O(n^3) (eigendecomposition cost), MEMORY by
+    O(n^2) (bytes held). Mirrors reference semantics
+    (/root/reference/kfac/enums.py:15-26).
+    """
+
+    COMPUTE = 1
+    MEMORY = 2
+
+
+class ComputeMethod(enum.Enum):
+    """Second-order representation: eigendecomposition or explicit inverse.
+
+    Mirrors reference semantics (/root/reference/kfac/enums.py:29-37).
+    """
+
+    EIGEN = 1
+    INVERSE = 2
+
+
+class DistributedStrategy(enum.Enum):
+    """KAISA gradient-worker strategy (reference kfac/enums.py:40-54).
+
+    On a TPU mesh this selects the sharding of eigendecompositions:
+
+    - COMM_OPT: grad_worker_fraction = 1. Decompositions are all-gathered so
+      every device preconditions its own gradients; no gradient broadcast.
+    - MEM_OPT: grad_worker_fraction = 1/world. Decompositions stay sharded on
+      their inverse worker; preconditioned gradients are broadcast from it.
+    - HYBRID_OPT: intermediate fractions; decompositions replicated within a
+      grad-worker submesh only.
+    """
+
+    COMM_OPT = 1
+    MEM_OPT = 2
+    HYBRID_OPT = 3

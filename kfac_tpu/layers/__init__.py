@@ -1,0 +1,5 @@
+"""Layer abstraction: helpers, registration, curvature capture."""
+
+from kfac_tpu.layers import capture, helpers, registry
+
+__all__ = ['capture', 'helpers', 'registry']

@@ -1,0 +1,217 @@
+"""Curvature capture: A/G statistics as part of the differentiated program.
+
+TPU-native replacement for the reference's autograd hooks
+(kfac/base_preconditioner.py:132-135,437-479; kfac/layers/base.py:345-373).
+JAX has no hooks and no mutable ``.grad``; instead:
+
+- **A factors** are computed inside the forward trace by a flax method
+  interceptor and returned as auxiliary outputs. Only the d_in^2 covariance is
+  kept — never the raw activations — so activation memory is O(d^2), not
+  O(batch*d) (the reference reduces in-hook for the same reason).
+- **G factors** use a ``custom_vjp`` identity "g-tap" on each layer output:
+  its backward rule computes ``g^T g / N`` *inside the backward pass* and
+  routes it out as the cotangent of a zero dummy argument. One
+  ``jax.value_and_grad`` call therefore yields loss, gradients, A stats, and
+  G stats, and XLA fuses the covariance matmuls into fwd/bwd — the analogue of
+  the reference's hook-async overlap (SURVEY.md section 3.2) falls out for
+  free from XLA scheduling.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kfac_tpu.layers import helpers as helpers_lib
+from kfac_tpu.layers import registry as registry_lib
+
+
+def _make_gtap(helper: helpers_lib.LayerHelper) -> Callable[..., jax.Array]:
+    """Identity on ``y`` whose vjp emits the layer G factor into ``gstat``."""
+
+    @jax.custom_vjp
+    def gtap(y: jax.Array, gstat: jax.Array) -> jax.Array:
+        del gstat
+        return y
+
+    def fwd(y: jax.Array, gstat: jax.Array):
+        del gstat
+        return y, None
+
+    def bwd(_, ybar: jax.Array):
+        return ybar, helper.get_g_factor(ybar)
+
+    gtap.defvjp(fwd, bwd)
+    return gtap
+
+
+class CurvatureCapture:
+    """Wraps a loss function to also emit per-layer curvature statistics.
+
+    Usage::
+
+        cap = CurvatureCapture(registry)
+        (loss, (aux, a_stats, counts)), (grads, g_stats) = cap.value_stats_and_grad(
+            loss_fn, has_aux=False)(params, batch)
+
+    ``loss_fn(params, *args)`` must evaluate the flax model via
+    ``model.apply`` (any number of registered modules, shared modules
+    allowed — repeated calls accumulate, tracked by ``counts``).
+    """
+
+    def __init__(self, registry: registry_lib.Registry):
+        self.registry = registry
+        self._gtaps = {
+            name: _make_gtap(helper)
+            for name, helper in registry.layers.items()
+        }
+
+    def zero_gstats(self) -> dict[str, jax.Array]:
+        """Zero dummy arguments whose gradients are the G factors."""
+        return {
+            name: jnp.zeros(h.g_factor_shape, dtype=h.factor_dtype)
+            for name, h in self.registry.layers.items()
+        }
+
+    def tapped(
+        self,
+        loss_fn: Callable[..., Any],
+        has_aux: bool = False,
+    ) -> Callable[..., Any]:
+        """Return ``f(params, gstats, *args) -> (loss, (aux, a_stats, counts))``.
+
+        Differentiating w.r.t. ``gstats`` yields the G factors.
+        """
+        registry = self.registry
+        gtaps = self._gtaps
+
+        def wrapped(params: Any, gstats: dict[str, jax.Array], *args: Any, **kwargs: Any):
+            a_stats: dict[str, jax.Array] = {}
+            counts: dict[str, jax.Array] = {}
+
+            def interceptor(next_fun, iargs, ikwargs, context):
+                mod = context.module
+                if context.method_name != '__call__' or not iargs:
+                    return next_fun(*iargs, **ikwargs)
+                name = registry_lib.path_name(mod.path)
+                helper = registry.layers.get(name)
+                if helper is None:
+                    return next_fun(*iargs, **ikwargs)
+                a = jax.lax.stop_gradient(iargs[0])
+                a_fac = helper.get_a_factor(a)
+                if name in a_stats:
+                    a_stats[name] = a_stats[name] + a_fac
+                    counts[name] = counts[name] + 1
+                else:
+                    a_stats[name] = a_fac
+                    counts[name] = jnp.asarray(1, dtype=jnp.int32)
+                y = next_fun(*iargs, **ikwargs)
+                return gtaps[name](y, gstats[name])
+
+            with nn.intercept_methods(interceptor):
+                out = loss_fn(params, *args, **kwargs)
+            if has_aux:
+                loss, aux = out
+            else:
+                loss, aux = out, None
+            return loss, (aux, a_stats, counts)
+
+        return wrapped
+
+    def value_stats_and_grad(
+        self,
+        loss_fn: Callable[..., Any],
+        has_aux: bool = False,
+    ) -> Callable[..., Any]:
+        """One call computing loss, grads, and curvature statistics.
+
+        Returns a function ``f(params, *args) ->
+        ((loss, aux), grads, CapturedStats)``. The counts divide repeated
+        module invocations (weight sharing / multiple calls), matching the
+        reference's per-call accumulation (kfac/layers/base.py:345-373).
+        """
+        tapped = self.tapped(loss_fn, has_aux=has_aux)
+        grad_fn = jax.value_and_grad(tapped, argnums=(0, 1), has_aux=True)
+
+        def run(params: Any, *args: Any, **kwargs: Any):
+            gstats_in = self.zero_gstats()
+            (loss, (aux, a_stats, counts)), (grads, g_stats) = grad_fn(
+                params, gstats_in, *args, **kwargs
+            )
+            a_avg = {
+                n: a_stats[n] / counts[n].astype(a_stats[n].dtype)
+                for n in a_stats
+            }
+            g_avg = {
+                n: g_stats[n] / counts[n].astype(g_stats[n].dtype)
+                for n in a_stats
+            }
+            stats = CapturedStats(a=a_avg, g=g_avg)
+            return (loss, aux), grads, stats
+
+        return run
+
+
+@jax.tree_util.register_pytree_node_class
+class CapturedStats:
+    """Per-batch factor statistics: name -> A and name -> G matrices."""
+
+    def __init__(self, a: dict[str, jax.Array], g: dict[str, jax.Array]):
+        self.a = a
+        self.g = g
+
+    def tree_flatten(self):
+        names = sorted(self.a)
+        return (
+            tuple(self.a[n] for n in names) + tuple(self.g[n] for n in names),
+            tuple(names),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, names, leaves):
+        n = len(names)
+        a = dict(zip(names, leaves[:n]))
+        g = dict(zip(names, leaves[n:]))
+        return cls(a=a, g=g)
+
+    def scaled(self, grad_scale: jax.Array | float) -> 'CapturedStats':
+        """Unscale G stats computed under a scaled loss (AMP loss scaling).
+
+        G is quadratic in g, so dividing by ``grad_scale**2`` matches the
+        reference's per-tensor unscale (kfac/layers/base.py:365-366).
+        """
+        s2 = grad_scale**2
+        return CapturedStats(
+            a=self.a,
+            g={n: v / s2 for n, v in self.g.items()},
+        )
+
+
+def accumulate_stats(
+    acc: CapturedStats | None,
+    new: CapturedStats,
+) -> CapturedStats:
+    """Sum statistics across gradient-accumulation micro-steps.
+
+    Divide by the number of micro-steps with :func:`average_stats` before
+    passing to ``update_factors``, mirroring the reference's accumulation
+    counter (kfac/layers/base.py:375-405).
+    """
+    if acc is None:
+        return new
+    return CapturedStats(
+        a={n: acc.a[n] + new.a[n] for n in acc.a},
+        g={n: acc.g[n] + new.g[n] for n in acc.g},
+    )
+
+
+def average_stats(acc: CapturedStats, num_steps: int | jax.Array) -> CapturedStats:
+    """Average accumulated statistics over ``num_steps`` micro-steps."""
+    return CapturedStats(
+        a={n: v / num_steps for n, v in acc.a.items()},
+        g={n: v / num_steps for n, v in acc.g.items()},
+    )

@@ -1,0 +1,163 @@
+"""Layer helpers: factor shapes, factor computation, grad matricization.
+
+The TPU-native analogue of the reference's ``ModuleHelper`` hierarchy
+(kfac/layers/modules.py:13-237). Instead of mutating ``module.weight.grad``,
+helpers convert between a layer's slice of the gradient pytree (flax param
+layout) and the dense (d_out, d_in [+ bias]) matrix form that the Kronecker
+preconditioner operates on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from kfac_tpu.ops import cov
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerHelper:
+    """Base helper. Subclasses describe one supported layer kind.
+
+    Attributes:
+        name: registry name (flax module path joined with '/').
+        has_bias: whether a bias column is folded into the A factor / grad.
+    """
+
+    name: str
+    has_bias: bool
+
+    @property
+    def a_factor_shape(self) -> tuple[int, int]:
+        raise NotImplementedError
+
+    @property
+    def g_factor_shape(self) -> tuple[int, int]:
+        raise NotImplementedError
+
+    def get_a_factor(self, a: jax.Array) -> jax.Array:
+        """Per-batch A factor from the layer input (forward tap)."""
+        raise NotImplementedError
+
+    def get_g_factor(self, g: jax.Array) -> jax.Array:
+        """Per-batch G factor from dL/d(layer output) (backward tap)."""
+        raise NotImplementedError
+
+    def grads_to_matrix(self, grads: dict[str, jax.Array]) -> jax.Array:
+        """Pack this layer's grad pytree leaves into (d_out, d_in[+1])."""
+        raise NotImplementedError
+
+    def matrix_to_grads(self, mat: jax.Array) -> dict[str, jax.Array]:
+        """Unpack a preconditioned matrix back into flax param layout."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseHelper(LayerHelper):
+    """Helper for dense layers (flax kernel layout (d_in, d_out)).
+
+    Reference equivalent: LinearModuleHelper
+    (kfac/layers/modules.py:100-141). A is ((d_in+bias), (d_in+bias)); G is
+    (d_out, d_out); leading batch/sequence dims collapse into covariance rows
+    so sequence models need no special casing.
+    """
+
+    in_features: int
+    out_features: int
+    factor_dtype: Any = jnp.float32
+
+    @property
+    def a_factor_shape(self) -> tuple[int, int]:
+        n = self.in_features + int(self.has_bias)
+        return (n, n)
+
+    @property
+    def g_factor_shape(self) -> tuple[int, int]:
+        return (self.out_features, self.out_features)
+
+    def get_a_factor(self, a: jax.Array) -> jax.Array:
+        return cov.linear_a_factor(a, self.has_bias, dtype=self.factor_dtype)
+
+    def get_g_factor(self, g: jax.Array) -> jax.Array:
+        return cov.linear_g_factor(g, dtype=self.factor_dtype)
+
+    def grads_to_matrix(self, grads: dict[str, jax.Array]) -> jax.Array:
+        mat = grads['kernel'].T
+        if self.has_bias:
+            mat = jnp.concatenate([mat, grads['bias'][:, None]], axis=1)
+        return mat
+
+    def matrix_to_grads(self, mat: jax.Array) -> dict[str, jax.Array]:
+        if self.has_bias:
+            return {'kernel': mat[:, :-1].T, 'bias': mat[:, -1]}
+        return {'kernel': mat.T}
+
+
+@dataclasses.dataclass(frozen=True)
+class Conv2dHelper(LayerHelper):
+    """Helper for 2D convolutions (flax NHWC / HWIO layout).
+
+    Reference equivalent: Conv2dModuleHelper
+    (kfac/layers/modules.py:144-237). Patch features are channel-major
+    (c, kh, kw), so the kernel matricizes as
+    ``transpose(k, (3, 2, 0, 1)).reshape(d_out, -1)`` — verified against
+    ``lax.conv_general_dilated`` output equality.
+    """
+
+    in_channels: int
+    out_channels: int
+    kernel_size: tuple[int, int]
+    strides: tuple[int, int]
+    padding: Any  # str or sequence of (lo, hi) pairs
+    factor_dtype: Any = jnp.float32
+
+    @property
+    def a_factor_shape(self) -> tuple[int, int]:
+        n = (
+            self.in_channels * self.kernel_size[0] * self.kernel_size[1]
+            + int(self.has_bias)
+        )
+        return (n, n)
+
+    @property
+    def g_factor_shape(self) -> tuple[int, int]:
+        return (self.out_channels, self.out_channels)
+
+    def get_a_factor(self, a: jax.Array) -> jax.Array:
+        return cov.conv2d_a_factor(
+            a,
+            kernel_size=self.kernel_size,
+            strides=self.strides,
+            padding=self.padding,
+            has_bias=self.has_bias,
+            dtype=self.factor_dtype,
+        )
+
+    def get_g_factor(self, g: jax.Array) -> jax.Array:
+        return cov.conv2d_g_factor(g, dtype=self.factor_dtype)
+
+    def grads_to_matrix(self, grads: dict[str, jax.Array]) -> jax.Array:
+        k = grads['kernel']  # (kh, kw, in, out)
+        mat = jnp.transpose(k, (3, 2, 0, 1)).reshape(k.shape[3], -1)
+        if self.has_bias:
+            mat = jnp.concatenate([mat, grads['bias'][:, None]], axis=1)
+        return mat
+
+    def matrix_to_grads(self, mat: jax.Array) -> dict[str, jax.Array]:
+        kh, kw = self.kernel_size
+        cin, cout = self.in_channels, self.out_channels
+        out: dict[str, jax.Array] = {}
+        w = mat[:, :-1] if self.has_bias else mat
+        k = w.reshape(cout, cin, kh, kw)
+        out['kernel'] = jnp.transpose(k, (2, 3, 1, 0))
+        if self.has_bias:
+            out['bias'] = mat[:, -1]
+        return out
+
+
+def matrix_param_count(helper: LayerHelper) -> int:
+    """Number of elements in the packed gradient matrix for a helper."""
+    return helper.g_factor_shape[0] * helper.a_factor_shape[0]

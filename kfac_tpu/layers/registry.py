@@ -1,0 +1,206 @@
+"""Model analysis: discover supported layers in a flax model.
+
+TPU-native replacement for the reference's module registration walk
+(kfac/layers/register.py:20-95). Instead of iterating ``model.modules()`` and
+attaching hooks, we trace the model once under ``jax.eval_shape`` with a flax
+method interceptor, recording every supported module invocation (path, kind,
+shapes, bias) — the same trace machinery later computes the curvature taps, so
+registration and capture can never disagree about which layers exist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Iterable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kfac_tpu.layers import helpers
+
+KNOWN_MODULES = ('dense', 'conv')
+
+
+def path_name(path: Iterable[str]) -> str:
+    return '/'.join(path)
+
+
+def any_match(query: str, patterns: list[re.Pattern[str]]) -> bool:
+    """True if any pattern fully matches the query.
+
+    Reference: kfac/layers/register.py:46-54.
+    """
+    return any(p.fullmatch(query) is not None for p in patterns)
+
+
+def _normalize_conv_geometry(mod: nn.Conv) -> tuple[tuple[int, int], tuple[int, int], Any]:
+    ks = mod.kernel_size
+    if isinstance(ks, int):
+        ks = (ks, ks)
+    strides = mod.strides or (1, 1)
+    if isinstance(strides, int):
+        strides = (strides, strides)
+    padding = mod.padding
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    elif not isinstance(padding, str):
+        # flax allows Sequence[int] or Sequence[(lo, hi)]; normalize to pairs
+        padding = [
+            (p, p) if isinstance(p, int) else tuple(p) for p in padding
+        ]
+    return tuple(ks), tuple(strides), padding
+
+
+def _conv_is_dilated(mod: nn.Conv) -> bool:
+    def nontrivial(d: Any) -> bool:
+        if d is None:
+            return False
+        if isinstance(d, int):
+            return d != 1
+        return any(x != 1 for x in d)
+
+    return nontrivial(mod.kernel_dilation) or nontrivial(mod.input_dilation)
+
+
+def make_helper(
+    module: nn.Module,
+    name: str,
+    input_shape: tuple[int, ...],
+    factor_dtype: Any = jnp.float32,
+) -> helpers.LayerHelper | None:
+    """Build a LayerHelper for a supported flax module, else None.
+
+    Type dispatch analogue of kfac/layers/register.py:36-43.
+    """
+    if isinstance(module, nn.Dense):
+        return helpers.DenseHelper(
+            name=name,
+            has_bias=module.use_bias,
+            in_features=input_shape[-1],
+            out_features=module.features,
+            factor_dtype=factor_dtype,
+        )
+    if isinstance(module, nn.Conv):
+        if len(input_shape) != 4:
+            return None  # only 2D convs (NHWC) are supported, like reference
+        ks, strides, padding = _normalize_conv_geometry(module)
+        if len(ks) != 2:
+            return None
+        if getattr(module, 'feature_group_count', 1) != 1:
+            return None  # grouped/depthwise convs unsupported (as in reference)
+        if _conv_is_dilated(module):
+            return None  # patch extraction assumes undilated receptive field
+        return helpers.Conv2dHelper(
+            name=name,
+            has_bias=module.use_bias,
+            in_channels=input_shape[-1],
+            out_channels=module.features,
+            kernel_size=ks,
+            strides=strides,
+            padding=padding,
+            factor_dtype=factor_dtype,
+        )
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Registry:
+    """Immutable result of model analysis.
+
+    ``layers`` maps registry name -> LayerHelper;
+    ``param_paths`` maps registry name -> tuple path into the params pytree
+    (the module path), used to slice gradients in and out.
+    """
+
+    layers: dict[str, helpers.LayerHelper]
+    param_paths: dict[str, tuple[str, ...]]
+
+    def __len__(self) -> int:
+        return len(self.layers)
+
+    def names(self) -> list[str]:
+        return list(self.layers)
+
+
+def register_model(
+    model: nn.Module,
+    *args: Any,
+    skip_layers: list[str] | None = None,
+    factor_dtype: Any = jnp.float32,
+    apply_fn: Callable[..., Any] | None = None,
+    **kwargs: Any,
+) -> Registry:
+    """Analyze ``model`` on example inputs and return its K-FAC registry.
+
+    Runs ``model.init`` under ``jax.eval_shape`` (no FLOPs, no memory) with an
+    interceptor that records each supported module call. ``skip_layers`` are
+    regex patterns matched against both the layer path name and the module
+    class name (reference semantics: kfac/layers/register.py:57-95).
+    """
+    skip_patterns = [re.compile(p) for p in (skip_layers or [])]
+    found: dict[str, helpers.LayerHelper] = {}
+    param_paths: dict[str, tuple[str, ...]] = {}
+
+    def interceptor(next_fun, iargs, ikwargs, context):
+        mod = context.module
+        if context.method_name != '__call__' or not iargs:
+            return next_fun(*iargs, **ikwargs)
+        x = iargs[0]
+        if not hasattr(x, 'shape'):
+            return next_fun(*iargs, **ikwargs)
+        name = path_name(mod.path)
+        cls_name = type(mod).__name__.lower()
+        if any_match(name, skip_patterns) or any_match(cls_name, skip_patterns):
+            return next_fun(*iargs, **ikwargs)
+        helper = make_helper(mod, name, tuple(x.shape), factor_dtype)
+        if helper is not None and name not in found:
+            found[name] = helper
+            param_paths[name] = tuple(mod.path)
+        return next_fun(*iargs, **ikwargs)
+
+    def probe(*a: Any, **kw: Any):
+        with nn.intercept_methods(interceptor):
+            if apply_fn is not None:
+                return apply_fn(*a, **kw)
+            return model.init(jax.random.PRNGKey(0), *a, **kw)
+
+    jax.eval_shape(probe, *args, **kwargs)
+    return Registry(layers=dict(found), param_paths=dict(param_paths))
+
+
+def slice_layer_grads(
+    grads: Any,
+    registry: Registry,
+) -> dict[str, dict[str, jax.Array]]:
+    """Extract each registered layer's grad leaves from a params-shaped pytree."""
+    out: dict[str, dict[str, jax.Array]] = {}
+    for name, path in registry.param_paths.items():
+        node = grads
+        for key in path:
+            node = node[key]
+        out[name] = dict(node)
+    return out
+
+
+def merge_layer_grads(
+    grads: Any,
+    layer_grads: dict[str, dict[str, jax.Array]],
+    registry: Registry,
+) -> Any:
+    """Write preconditioned layer grads back into a full grad pytree (pure)."""
+
+    def replace(node: Any, path: tuple[str, ...], value: dict[str, jax.Array]) -> Any:
+        if not path:
+            new = dict(node)
+            new.update(value)
+            return new
+        new = dict(node)
+        new[path[0]] = replace(node[path[0]], path[1:], value)
+        return new
+
+    out = grads
+    for name, value in layer_grads.items():
+        out = replace(out, registry.param_paths[name], value)
+    return out

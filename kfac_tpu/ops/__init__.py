@@ -1,0 +1,5 @@
+"""Numerics: covariance factors and second-order linear algebra."""
+
+from kfac_tpu.ops import cov, factors
+
+__all__ = ['cov', 'factors']

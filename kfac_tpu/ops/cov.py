@@ -1,0 +1,164 @@
+"""Covariance (Kronecker factor) numerics.
+
+Pure jnp, jit-friendly: static shapes, no Python control flow on traced
+values. Semantics match the reference math in
+/root/reference/kfac/layers/utils.py:8-83 and
+/root/reference/kfac/layers/modules.py:100-237, computed the XLA way
+(``conv_general_dilated_patches`` instead of ``unfold``; reductions fuse into
+the surrounding fwd/bwd).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def append_bias_ones(x: jax.Array) -> jax.Array:
+    """Append a column of ones to the last dimension of ``x``.
+
+    Reference: kfac/layers/utils.py:8-15.
+    """
+    shape = x.shape[:-1] + (1,)
+    return jnp.concatenate([x, jnp.ones(shape, dtype=x.dtype)], axis=-1)
+
+
+def get_cov(
+    a: jax.Array,
+    b: jax.Array | None = None,
+    scale: float | jax.Array | None = None,
+) -> jax.Array:
+    """Empirical second moment of a 2D tensor: ``a^T @ (b or a) / scale``.
+
+    The self-covariance is symmetrized ``(C + C^T)/2`` to guard against
+    floating-point asymmetry before eigh. Reference:
+    kfac/layers/utils.py:18-59.
+    """
+    if a.ndim != 2:
+        raise ValueError(f'expected 2D tensor, got shape {a.shape}')
+    if b is not None and a.shape != b.shape:
+        raise ValueError(f'shape mismatch: {a.shape} vs {b.shape}')
+    if scale is None:
+        scale = a.shape[0]
+    if b is None:
+        cov = a.T @ (a / scale)
+        return (cov + cov.T) / 2.0
+    return a.T @ (b / scale)
+
+
+def reshape_data(
+    tensors: Sequence[jax.Array],
+    batch_first: bool = True,
+    collapse_dims: bool = False,
+) -> jax.Array:
+    """Concatenate tensors along the batch dim, optionally collapsing to 2D.
+
+    Reference: kfac/layers/utils.py:62-83.
+    """
+    d = jnp.concatenate(list(tensors), axis=int(not batch_first))
+    if collapse_dims and d.ndim > 2:
+        d = d.reshape(-1, d.shape[-1])
+    return d
+
+
+def extract_patches_nhwc(
+    x: jax.Array,
+    kernel_size: tuple[int, int],
+    strides: tuple[int, int],
+    padding: str | Sequence[tuple[int, int]],
+) -> jax.Array:
+    """im2col for NHWC images -> (batch, out_h, out_w, in_c * kh * kw).
+
+    Feature ordering is channel-major (c, kh, kw), matching
+    ``lax.conv_general_dilated_patches`` and the (out, in*kh*kw) weight
+    matricization used by the conv helper. TPU-native replacement for the
+    reference's ``Tensor.unfold`` chain
+    (kfac/layers/modules.py:210-237).
+    """
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pad = [tuple(p) for p in padding]
+    patches = jax.lax.conv_general_dilated_patches(
+        x,
+        filter_shape=kernel_size,
+        window_strides=strides,
+        padding=pad,
+        dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
+    )
+    return patches
+
+
+def linear_a_factor(
+    a: jax.Array,
+    has_bias: bool,
+    dtype: jnp.dtype | None = None,
+) -> jax.Array:
+    """A factor for a dense layer from its input activations.
+
+    Flattens leading dims into rows ((batch, seq, d) -> (batch*seq, d)),
+    appends the bias column of ones, and returns the scaled covariance.
+    Reference: kfac/layers/modules.py:123-132.
+    """
+    if dtype is not None:
+        a = a.astype(dtype)
+    a = a.reshape(-1, a.shape[-1])
+    if has_bias:
+        a = append_bias_ones(a)
+    return get_cov(a)
+
+
+def linear_g_factor(
+    g: jax.Array,
+    dtype: jnp.dtype | None = None,
+) -> jax.Array:
+    """G factor for a dense layer from the loss gradient w.r.t. its output.
+
+    Reference: kfac/layers/modules.py:134-141.
+    """
+    if dtype is not None:
+        g = g.astype(dtype)
+    g = g.reshape(-1, g.shape[-1])
+    return get_cov(g)
+
+
+def conv2d_a_factor(
+    a: jax.Array,
+    kernel_size: tuple[int, int],
+    strides: tuple[int, int],
+    padding: str | Sequence[tuple[int, int]],
+    has_bias: bool,
+    dtype: jnp.dtype | None = None,
+) -> jax.Array:
+    """A factor for a 2D conv layer (NHWC input).
+
+    Patch rows are normalized by the spatial output size, mirroring the
+    reference's KFC normalization (kfac/layers/modules.py:173-182).
+    """
+    if dtype is not None:
+        a = a.astype(dtype)
+    patches = extract_patches_nhwc(a, kernel_size, strides, padding)
+    spatial_size = patches.shape[1] * patches.shape[2]
+    rows = patches.reshape(-1, patches.shape[-1])
+    if has_bias:
+        rows = append_bias_ones(rows)
+    rows = rows / spatial_size
+    return get_cov(rows)
+
+
+def conv2d_g_factor(
+    g: jax.Array,
+    dtype: jnp.dtype | None = None,
+) -> jax.Array:
+    """G factor for a 2D conv layer from NHWC output gradients.
+
+    Reference (NCHW variant): kfac/layers/modules.py:184-194.
+    """
+    if dtype is not None:
+        g = g.astype(dtype)
+    spatial_size = g.shape[1] * g.shape[2]
+    rows = g.reshape(-1, g.shape[-1])
+    rows = rows / spatial_size
+    return get_cov(rows)

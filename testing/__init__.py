@@ -1,0 +1,2 @@
+"""Reusable test fixtures for kfac_tpu (analogue of the reference's
+``testing/`` package: models, fake assignments, mesh helpers)."""

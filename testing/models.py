@@ -1,0 +1,66 @@
+"""Tiny flax models for tests (analogue of reference testing/models.py:13-67)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class TinyModel(nn.Module):
+    """Two dense layers, the smallest end-to-end K-FAC target."""
+
+    hidden: int = 8
+    out: int = 4
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Dense(self.hidden, name='fc1')(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.out, name='fc2')(x)
+        return x
+
+
+class TinyConvNet(nn.Module):
+    """LeNet-flavored conv+dense stack (NHWC)."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Conv(6, (5, 5), padding='VALID', name='conv1')(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding='VALID', name='conv2')(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(32, name='fc1')(x)
+        x = nn.relu(x)
+        x = nn.Dense(10, name='fc2')(x)
+        return x
+
+
+class SharedDense(nn.Module):
+    """Calls the same dense module twice (weight sharing / accumulation)."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d = nn.Dense(x.shape[-1], name='shared')
+        return d(nn.relu(d(x)))
+
+
+def regression_data(key: jax.Array, n: int = 32, dim: int = 6):
+    """Deterministic least-squares problem with a fixed optimal map."""
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, (n, dim))
+    w_true = jax.random.normal(k2, (dim, 4))
+    y = jnp.tanh(x @ w_true)
+    return x, y
+
+
+def mse_loss(model: nn.Module):
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = model.apply({'params': params}, x)
+        return jnp.mean((pred - y) ** 2)
+
+    return loss_fn

@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The reference simulates clusters by forking gloo process groups
+(testing/distributed.py:24-141). The JAX equivalent is a host-platform
+device-count override: the same SPMD program that runs on a TPU pod runs on
+8 virtual CPU devices, so every sharding/collective path is exercised
+in-process. This must happen before the first JAX backend initialization.
+
+Note: the container's sitecustomize registers an `axon` TPU plugin that
+forces jax_platforms; overriding the config here keeps tests off the (single,
+exclusive) TPU tunnel.
+"""
+
+import os
+
+flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8'
+    )
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_enable_x64', False)

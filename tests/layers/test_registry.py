@@ -1,0 +1,94 @@
+"""Registration tests (behavioral targets from reference
+tests/layers/register_test.py: discovery, nesting, skip patterns)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kfac_tpu.layers import helpers, registry
+from testing import models
+
+
+def test_register_tiny_model():
+    m = models.TinyModel()
+    reg = registry.register_model(m, jnp.ones((2, 6)))
+    assert set(reg.names()) == {'fc1', 'fc2'}
+    h1 = reg.layers['fc1']
+    assert isinstance(h1, helpers.DenseHelper)
+    assert h1.a_factor_shape == (7, 7)  # 6 in + bias
+    assert h1.g_factor_shape == (8, 8)
+    assert reg.param_paths['fc1'] == ('fc1',)
+
+
+def test_register_conv_model():
+    m = models.TinyConvNet()
+    reg = registry.register_model(m, jnp.ones((2, 32, 32, 1)))
+    assert set(reg.names()) == {'conv1', 'conv2', 'fc1', 'fc2'}
+    c1 = reg.layers['conv1']
+    assert isinstance(c1, helpers.Conv2dHelper)
+    assert c1.a_factor_shape == (1 * 25 + 1, 1 * 25 + 1)
+    assert c1.g_factor_shape == (6, 6)
+    c2 = reg.layers['conv2']
+    assert c2.a_factor_shape == (6 * 25 + 1, 6 * 25 + 1)
+
+
+def test_register_nested_paths():
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4, name='inner')(x)
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = Block(name='b1')(x)
+            return Block(name='b2')(x)
+
+    reg = registry.register_model(Net(), jnp.ones((2, 4)))
+    assert set(reg.names()) == {'b1/inner', 'b2/inner'}
+    assert reg.param_paths['b1/inner'] == ('b1', 'inner')
+
+
+def test_skip_patterns_by_name_and_class():
+    m = models.TinyModel()
+    reg = registry.register_model(m, jnp.ones((2, 6)), skip_layers=['fc1'])
+    assert set(reg.names()) == {'fc2'}
+    # class-name skip, case-insensitive-ish: class names are lowercased
+    reg2 = registry.register_model(m, jnp.ones((2, 6)), skip_layers=['dense'])
+    assert len(reg2) == 0
+
+
+def test_skip_pattern_regex():
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(4, name='attn_q')(x)
+            x = nn.Dense(4, name='attn_k')(x)
+            return nn.Dense(4, name='mlp')(x)
+
+    reg = registry.register_model(Net(), jnp.ones((2, 4)), skip_layers=['attn.*'])
+    assert set(reg.names()) == {'mlp'}
+
+
+def test_no_bias_shapes():
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4, use_bias=False, name='d')(x)
+
+    reg = registry.register_model(Net(), jnp.ones((2, 5)))
+    assert reg.layers['d'].a_factor_shape == (5, 5)
+
+
+def test_slice_and_merge_roundtrip():
+    m = models.TinyConvNet()
+    import jax
+
+    variables = m.init(jax.random.PRNGKey(0), jnp.ones((1, 32, 32, 1)))
+    params = variables['params']
+    reg = registry.register_model(m, jnp.ones((1, 32, 32, 1)))
+    sliced = registry.slice_layer_grads(params, reg)
+    assert set(sliced) == set(reg.names())
+    merged = registry.merge_layer_grads(params, sliced, reg)
+    flat1 = jax.tree_util.tree_leaves(params)
+    flat2 = jax.tree_util.tree_leaves(merged)
+    assert all((a == b).all() for a, b in zip(flat1, flat2))

@@ -1,0 +1,94 @@
+"""Unit tests for factor math: EMA, eigh, inverse, preconditioning, kl-clip."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_tpu.ops import factors
+
+
+def _random_spd(n, seed):
+    rng = np.random.default_rng(seed)
+    m = rng.normal(size=(n, n)).astype(np.float32)
+    return m @ m.T / n + 0.1 * np.eye(n, dtype=np.float32)
+
+
+def test_ema_update_identity_init():
+    new = jnp.full((3, 3), 2.0)
+    out = factors.ema_update(None, new, alpha=0.95)
+    expected = 0.95 * np.eye(3) + 0.05 * 2.0 * np.ones((3, 3))
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
+def test_ema_update_running():
+    run = jnp.ones((2, 2))
+    new = jnp.zeros((2, 2))
+    out = factors.ema_update(run, new, alpha=0.5)
+    np.testing.assert_allclose(out, 0.5 * np.ones((2, 2)))
+
+
+def test_eigh_reconstructs_and_clamps():
+    f = _random_spd(6, 0)
+    dec = factors.compute_eigh(jnp.asarray(f))
+    recon = np.asarray(dec.q) @ np.diag(np.asarray(dec.d)) @ np.asarray(dec.q).T
+    np.testing.assert_allclose(recon, f, rtol=1e-4, atol=1e-5)
+    assert (np.asarray(dec.d) >= 0).all()
+
+
+def test_inverse_matches_numpy():
+    f = _random_spd(5, 1)
+    damping = 0.01
+    inv = factors.compute_inverse(jnp.asarray(f), damping)
+    expected = np.linalg.inv(f + damping * np.eye(5))
+    np.testing.assert_allclose(inv, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_eigen_precondition_equals_explicit_inverse_formula():
+    """qg [ (qg^T W qa) / (dg x da + l) ] qa^T == (G x A + l)^-1 applied."""
+    a = _random_spd(4, 2)
+    g = _random_spd(3, 3)
+    grad = np.random.default_rng(4).normal(size=(3, 4)).astype(np.float32)
+    damping = 0.05
+    adec = factors.compute_eigh(jnp.asarray(a))
+    gdec = factors.compute_eigh(jnp.asarray(g))
+    got = factors.eigen_preconditioned_grad(jnp.asarray(grad), adec, gdec, damping)
+    # explicit Kronecker solve: vec form with kron(A, G) (row-major vec)
+    kron = np.kron(a, g) + damping * np.eye(12)
+    vec = grad.T.reshape(-1)  # column-major stacking matches kron(A, G)
+    expected = np.linalg.solve(kron, vec).reshape(4, 3).T
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_prediv_matches_on_the_fly_division():
+    a = _random_spd(4, 5)
+    g = _random_spd(3, 6)
+    grad = np.random.default_rng(7).normal(size=(3, 4)).astype(np.float32)
+    damping = 0.01
+    adec = factors.compute_eigh(jnp.asarray(a))
+    gdec = factors.compute_eigh(jnp.asarray(g))
+    direct = factors.eigen_preconditioned_grad(
+        jnp.asarray(grad), adec, gdec, damping
+    )
+    dgda = factors.prediv_eigenvalues(adec, gdec, damping)
+    v1 = np.asarray(gdec.q).T @ grad @ np.asarray(adec.q)
+    via_prediv = np.asarray(gdec.q) @ (v1 * np.asarray(dgda)) @ np.asarray(adec.q).T
+    np.testing.assert_allclose(direct, via_prediv, rtol=1e-4, atol=1e-5)
+
+
+def test_inverse_precondition_formula():
+    a_inv = _random_spd(4, 8)
+    g_inv = _random_spd(3, 9)
+    grad = np.random.default_rng(10).normal(size=(3, 4)).astype(np.float32)
+    got = factors.inverse_preconditioned_grad(
+        jnp.asarray(grad), jnp.asarray(a_inv), jnp.asarray(g_inv)
+    )
+    np.testing.assert_allclose(got, g_inv @ grad @ a_inv, rtol=1e-4, atol=1e-4)
+
+
+def test_kl_clip_scale():
+    assert float(factors.kl_clip_scale(jnp.asarray(0.0), 0.001)) == 1.0
+    # |vg| tiny -> clipped at 1
+    assert float(factors.kl_clip_scale(jnp.asarray(1e-9), 0.001)) == 1.0
+    got = float(factors.kl_clip_scale(jnp.asarray(4.0), 0.001))
+    np.testing.assert_allclose(got, np.sqrt(0.001 / 4.0), rtol=1e-6)
+    got_neg = float(factors.kl_clip_scale(jnp.asarray(-4.0), 0.001))
+    np.testing.assert_allclose(got_neg, np.sqrt(0.001 / 4.0), rtol=1e-6)
