@@ -20,9 +20,6 @@ import jax.numpy as jnp
 
 from kfac_tpu.layers import helpers
 
-KNOWN_MODULES = ('dense', 'conv')
-
-
 def path_name(path: Iterable[str]) -> str:
     return '/'.join(path)
 
@@ -92,6 +89,12 @@ def make_helper(
             return None  # grouped/depthwise convs unsupported (as in reference)
         if _conv_is_dilated(module):
             return None  # patch extraction assumes undilated receptive field
+        if isinstance(module.padding, str) and module.padding.upper() not in (
+            'SAME', 'VALID',
+        ):
+            # flax implements CIRCULAR/CAUSAL/REFLECT by pre-padding; the
+            # patch geometry would be wrong, so leave such convs unregistered
+            return None
         return helpers.Conv2dHelper(
             name=name,
             has_bias=module.use_bias,

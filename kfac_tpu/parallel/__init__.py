@@ -1,0 +1,16 @@
+"""Distributed execution: meshes, collectives, KAISA sharded engine."""
+
+from kfac_tpu.parallel import collectives, mesh
+from kfac_tpu.parallel.kaisa import DistKFACState, DistributedKFAC, build_buckets
+from kfac_tpu.parallel.mesh import batch_sharding, kaisa_mesh, replicated
+
+__all__ = [
+    'DistKFACState',
+    'DistributedKFAC',
+    'batch_sharding',
+    'build_buckets',
+    'collectives',
+    'kaisa_mesh',
+    'mesh',
+    'replicated',
+]

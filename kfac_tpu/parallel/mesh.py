@@ -1,0 +1,62 @@
+"""Mesh construction for KAISA execution.
+
+The reference builds torch process groups per rank-set
+(kfac/assignment.py:193-201). On TPU the topology is declarative: a
+``jax.sharding.Mesh`` with axes ('gw', 'col') *is* the KAISA worker/receiver
+grid (columns = gradient-worker groups, rows = receiver groups), and the two
+KAISA broadcasts become all-gathers along one axis each. Data parallelism
+shards the batch over both axes jointly, so the same devices serve as the
+data-parallel world (KAISA's "strong data-parallel training" assumption,
+kfac/assignment.py:442-453).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kfac_tpu import assignment as assignment_lib
+
+GW_AXIS = 'kfac_gw'
+COL_AXIS = 'kfac_col'
+DATA_AXES = (GW_AXIS, COL_AXIS)
+
+
+def kaisa_mesh(
+    grad_worker_fraction: float = 1.0,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the (grad_workers x world/grad_workers) KAISA mesh.
+
+    Device d sits at (row, col) = divmod(d, n_cols), matching
+    :func:`kfac_tpu.assignment.partition_grad_workers`.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    world = len(devices)
+    workers = assignment_lib.grad_worker_count(world, grad_worker_fraction)
+    grid = np.asarray(devices, dtype=object).reshape(workers, world // workers)
+    return Mesh(grid, (GW_AXIS, COL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading batch dim over every device (pure data parallel)."""
+    return NamedSharding(mesh, P(DATA_AXES))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def world_size(mesh: Mesh) -> int:
+    return mesh.devices.size
+
+
+def n_cols(mesh: Mesh) -> int:
+    return mesh.shape[COL_AXIS]
+
+
+def grad_workers(mesh: Mesh) -> int:
+    return mesh.shape[GW_AXIS]

@@ -86,10 +86,10 @@ class KFACPreconditioner:
         compute_method: EIGEN (default) or INVERSE.
         prediv_eigenvalues: precompute 1/(dg x da + damping) at inv time.
         factor_dtype / inv_dtype: storage dtypes (decomps always run fp32).
-        inverse_fn: optional replacement for the dense per-layer inverse
-            loop, called as ``inverse_fn(precond, state, damping) -> state``
-            (installed by kfac_tpu.parallel when KAISA sharded execution is
-            active).
+
+    For sharded KAISA execution over a mesh use
+    :class:`kfac_tpu.parallel.DistributedKFAC`, which reads its
+    hyperparameters from an instance of this class.
     """
 
     registry: registry_lib.Registry
@@ -103,7 +103,6 @@ class KFACPreconditioner:
     prediv_eigenvalues: bool = False
     factor_dtype: Any = jnp.float32
     inv_dtype: Any = jnp.float32
-    inverse_fn: Callable[..., Any] | None = None
 
     def __post_init__(self) -> None:
         if isinstance(self.compute_method, str):
@@ -193,12 +192,8 @@ class KFACPreconditioner:
         """Recompute eigendecompositions (or inverses) from current factors.
 
         Reference: kfac/layers/eigen.py:295-348, kfac/layers/inverse.py:186-213.
-        When ``inverse_fn`` is installed (KAISA sharded execution), it
-        replaces the dense per-layer loop.
         """
         damping = _resolve(self.damping, state.step)
-        if self.inverse_fn is not None:
-            return self.inverse_fn(self, state, damping)
         if self.compute_method == enums.ComputeMethod.EIGEN:
             qa, qg = dict(state.qa), dict(state.qg)
             da, dg = dict(state.da), dict(state.dg)

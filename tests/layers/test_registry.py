@@ -92,3 +92,19 @@ def test_slice_and_merge_roundtrip():
     flat1 = jax.tree_util.tree_leaves(params)
     flat2 = jax.tree_util.tree_leaves(merged)
     assert all((a == b).all() for a, b in zip(flat1, flat2))
+
+
+def test_unsupported_conv_variants_not_registered():
+    """Dilated, grouped, and exotic-padding convs stay unregistered instead of
+    failing later in capture."""
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Conv(4, (3, 3), kernel_dilation=2, name='dil')(x)
+            x = nn.Conv(4, (3, 3), padding='CIRCULAR', name='circ')(x)
+            x = nn.Conv(4, (3, 3), feature_group_count=2, name='grp')(x)
+            return nn.Conv(4, (3, 3), name='ok')(x)
+
+    from kfac_tpu.layers import registry as _r
+    reg = _r.register_model(Net(), jnp.ones((1, 8, 8, 2)))
+    assert set(reg.names()) == {'ok'}

@@ -1,0 +1,200 @@
+"""Property tests for the KAISA assignment engine.
+
+Behavioral targets from the reference's table-driven suite
+(tests/assignment_test.py:61-541): grid partition properties, greedy balance,
+worker/receiver group consistency — expressed as properties over sweeps of
+world sizes and fractions rather than literal tables.
+"""
+
+import pytest
+
+from kfac_tpu import assignment, enums
+
+
+def _work(n_layers, base=10.0):
+    return {
+        f'layer{i}': {'A': base * (i + 1), 'G': base * (i + 1) / 2}
+        for i in range(n_layers)
+    }
+
+
+@pytest.mark.parametrize('world,workers', [(8, 2), (8, 8), (8, 1), (4, 2), (12, 3)])
+def test_grid_partitions_cover_world(world, workers):
+    cols = assignment.partition_grad_workers(world, workers)
+    rows = assignment.partition_grad_receivers(world, workers)
+    assert sorted(d for c in cols for d in c) == list(range(world))
+    assert sorted(d for r in rows for d in r) == list(range(world))
+    assert all(len(c) == workers for c in cols)
+    assert all(len(r) == world // workers for r in rows)
+    # every (row, col) pair intersects in exactly one device
+    for r in rows:
+        for c in cols:
+            assert len(set(r) & set(c)) == 1
+
+
+def test_grid_example_from_kaisa_paper():
+    # world 8, 2 grad workers: columns [0,4],[1,5],[2,6],[3,7]; rows
+    # [0..3],[4..7] (reference docstring example kfac/assignment.py:330-342)
+    cols = assignment.partition_grad_workers(8, 2)
+    assert cols == [(0, 4), (1, 5), (2, 6), (3, 7)]
+    rows = assignment.partition_grad_receivers(8, 2)
+    assert rows == [(0, 1, 2, 3), (4, 5, 6, 7)]
+
+
+def test_grid_rejects_nondivisible():
+    with pytest.raises(ValueError):
+        assignment.partition_grad_workers(8, 3)
+
+
+@pytest.mark.parametrize(
+    'world,frac,expected',
+    [
+        (8, 1.0, enums.DistributedStrategy.COMM_OPT),
+        (8, 0.0, enums.DistributedStrategy.MEM_OPT),
+        (8, 1 / 8, enums.DistributedStrategy.MEM_OPT),
+        (8, 0.5, enums.DistributedStrategy.HYBRID_OPT),
+        (8, 0.25, enums.DistributedStrategy.HYBRID_OPT),
+        (1, 1.0, enums.DistributedStrategy.COMM_OPT),
+    ],
+)
+def test_fraction_to_strategy(world, frac, expected):
+    assert assignment.strategy_for_fraction(world, frac) == expected
+
+
+def test_fraction_validation():
+    with pytest.raises(ValueError):
+        assignment.grad_worker_count(8, 0.3)  # 2.4 workers
+    with pytest.raises(ValueError):
+        assignment.grad_worker_count(8, -0.1)
+    with pytest.raises(ValueError):
+        assignment.grad_worker_count(8, 1.1)
+    # 8 * 0.75 = 6 is an integer but does not divide 8
+    with pytest.raises(ValueError):
+        assignment.grad_worker_count(8, 0.75)
+
+
+def test_greedy_assignment_balances_uniform_work():
+    work = {f'l{i}': {'A': 1.0, 'G': 1.0} for i in range(16)}
+    groups = [tuple(range(4))]
+    placement = assignment.greedy_assign(work, groups, 4, colocate_factors=True)
+    loads = [0.0] * 4
+    for layer, factors in placement.items():
+        for f, d in factors.items():
+            loads[d] += work[layer][f]
+    assert max(loads) == min(loads)  # 16 equal layers over 4 devices
+
+
+def test_greedy_colocation():
+    work = _work(6)
+    placement = assignment.greedy_assign(
+        work, [tuple(range(4))], 4, colocate_factors=True
+    )
+    for layer, factors in placement.items():
+        assert factors['A'] == factors['G']
+
+
+def test_greedy_no_colocation_spreads_within_group():
+    work = {'big': {'A': 100.0, 'G': 100.0}}
+    placement = assignment.greedy_assign(
+        work, [(0, 1)], 2, colocate_factors=False
+    )
+    # two equal factors, two idle devices in the group: one each
+    assert {placement['big']['A'], placement['big']['G']} == {0, 1}
+
+
+def test_greedy_respects_group_constraint():
+    work = _work(8)
+    groups = [(0, 2), (1, 3)]  # columns of a 2x2 grid
+    placement = assignment.greedy_assign(work, groups, 4, colocate_factors=False)
+    for layer, factors in placement.items():
+        devs = set(factors.values())
+        assert devs <= {0, 2} or devs <= {1, 3}
+
+
+def test_greedy_deterministic():
+    work = _work(10)
+    a = assignment.greedy_assign(work, [(0, 1), (2, 3)], 4, True)
+    b = assignment.greedy_assign(work, [(0, 1), (2, 3)], 4, True)
+    assert a == b
+
+
+@pytest.mark.parametrize('world,frac', [(8, 1.0), (8, 0.5), (8, 0.25), (8, 1 / 8), (4, 0.5), (1, 1.0)])
+def test_kaisa_assignment_consistency(world, frac):
+    kaisa = assignment.KAISAAssignment(
+        _work(7), world_size=world, grad_worker_fraction=frac
+    )
+    m, n = kaisa.mesh_shape()
+    assert m * n == world
+    for layer in kaisa.get_layers():
+        col = kaisa.grad_worker_group(layer)
+        assert len(col) == kaisa.grad_workers
+        for factor in kaisa.get_factors(layer):
+            assert kaisa.inv_worker(layer, factor) in col
+        for dev in range(world):
+            row = kaisa.grad_receiver_group(dev, layer)
+            assert dev in row
+            src = kaisa.src_grad_worker(dev, layer)
+            # the source sits in both this device's row and the layer column
+            assert src in row and src in col
+            if kaisa.is_grad_worker(dev, layer):
+                assert src == dev
+        # every device is either a grad worker or receives from one
+        workers = [d for d in range(world) if kaisa.is_grad_worker(d, layer)]
+        assert len(workers) == kaisa.grad_workers
+
+
+def test_comm_opt_no_grad_broadcast_mem_opt_no_inv_broadcast():
+    comm = assignment.KAISAAssignment(_work(3), world_size=4, grad_worker_fraction=1.0)
+    assert not comm.broadcast_gradients() and comm.broadcast_inverses()
+    mem = assignment.KAISAAssignment(_work(3), world_size=4, grad_worker_fraction=0.0)
+    assert mem.broadcast_gradients() and not mem.broadcast_inverses()
+    hybrid = assignment.KAISAAssignment(_work(3), world_size=4, grad_worker_fraction=0.5)
+    assert hybrid.broadcast_gradients() and hybrid.broadcast_inverses()
+
+
+def test_mem_opt_requires_colocation():
+    with pytest.raises(ValueError):
+        assignment.KAISAAssignment(
+            _work(3), world_size=4, grad_worker_fraction=0.0, colocate_factors=False
+        )
+
+
+def test_world_size_one_trivial():
+    kaisa = assignment.KAISAAssignment(_work(3), world_size=1, grad_worker_fraction=1.0)
+    for layer in kaisa.get_layers():
+        assert kaisa.grad_worker_group(layer) == (0,)
+        assert kaisa.inv_worker(layer, 'A') == 0
+        assert kaisa.src_grad_worker(0, layer) == 0
+    assert not kaisa.broadcast_gradients()
+    assert not kaisa.broadcast_inverses()
+
+
+def test_compute_work_costs_cubic_vs_quadratic():
+    class H:
+        a_factor_shape = (10, 10)
+        g_factor_shape = (4, 4)
+
+    costs = assignment.compute_work_costs({'l': H()})
+    assert costs == {'l': {'A': 1000.0, 'G': 64.0}}
+    costs_mem = assignment.compute_work_costs(
+        {'l': H()}, enums.AssignmentStrategy.MEMORY
+    )
+    assert costs_mem == {'l': {'A': 100.0, 'G': 16.0}}
+
+
+def test_greedy_balance_quality():
+    """Greedy keeps the max/mean load ratio modest on heterogeneous work."""
+    import random
+
+    rng = random.Random(0)
+    work = {
+        f'l{i}': {'A': float(rng.randint(1, 100)) ** 3, 'G': float(rng.randint(1, 100)) ** 3}
+        for i in range(40)
+    }
+    kaisa = assignment.KAISAAssignment(work, world_size=8, grad_worker_fraction=0.5)
+    loads = [0.0] * 8
+    for layer in kaisa.get_layers():
+        for f in kaisa.get_factors(layer):
+            loads[kaisa.inv_worker(layer, f)] += work[layer][f]
+    mean = sum(loads) / len(loads)
+    assert max(loads) < 2.0 * mean
