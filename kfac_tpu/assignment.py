@@ -89,11 +89,13 @@ def grad_worker_count(
         )
     if world_size < 1:
         raise ValueError('world_size must be >= 1')
-    count = max(1, world_size * grad_worker_fraction)
-    if abs(count - round(count)) > 1e-8:
+    if grad_worker_fraction == 0:
+        return 1  # documented MEM-OPT alias (reference kfac/preconditioner.py)
+    count = world_size * grad_worker_fraction
+    if abs(count - round(count)) > 1e-8 or round(count) < 1:
         raise ValueError(
             f'world_size * grad_worker_fraction = {world_size} * '
-            f'{grad_worker_fraction} is not an integer'
+            f'{grad_worker_fraction} is not a positive integer'
         )
     count = int(round(count))
     if world_size % count != 0:

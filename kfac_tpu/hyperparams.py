@@ -1,0 +1,101 @@
+"""Common hyperparameter schedules.
+
+JAX-flavored counterparts of the reference's schedule utilities
+(kfac/hyperparams.py:8-47, kfac/scheduler.py:11-167). Because every
+hyperparameter of :class:`kfac_tpu.KFACPreconditioner` is already
+callable-or-constant *resolved on the traced step counter*, there is no
+mutable scheduler object to drive from the training loop: schedules are pure
+functions composed ahead of time and baked into the compiled step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Schedule = Callable[[jax.Array], jax.Array]
+
+
+def exp_decay_factor_averaging(min_value: float = 0.95) -> Schedule:
+    """Martens et al. (2015) running-average weight: ``min(1 - 1/k, cap)``.
+
+    Reference: kfac/hyperparams.py:8-47 (step 0 treated as 1). Returns a
+    traced-step-compatible callable for ``factor_decay``.
+    """
+    if min_value <= 0:
+        raise ValueError('min_value must be greater than 0')
+
+    def schedule(step: jax.Array) -> jax.Array:
+        k = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        return jnp.minimum(1.0 - 1.0 / k, min_value)
+
+    return schedule
+
+
+def lambda_schedule(
+    base: float,
+    factor_lambda: Callable[[jax.Array], jax.Array | float],
+) -> Schedule:
+    """Multiplicative lambda schedule: ``base * factor_lambda(step)``.
+
+    The functional equivalent of the reference's ``LambdaParamScheduler``
+    (kfac/scheduler.py:119-167), which mutates preconditioner attributes per
+    step; here the composition happens once and runs inside the compiled
+    step. Use for damping / factor_decay / kl_clip / lr.
+    """
+
+    def schedule(step: jax.Array) -> jax.Array:
+        return jnp.asarray(base) * factor_lambda(step)
+
+    return schedule
+
+
+def piecewise_constant(
+    boundaries: Sequence[int],
+    values: Sequence[float],
+) -> Schedule:
+    """Step function: values[i] for step in [boundaries[i-1], boundaries[i]).
+
+    len(values) == len(boundaries) + 1.
+    """
+    if len(values) != len(boundaries) + 1:
+        raise ValueError('need len(values) == len(boundaries) + 1')
+    bounds = jnp.asarray(boundaries)
+    vals = jnp.asarray(values, jnp.float32)
+
+    def schedule(step: jax.Array) -> jax.Array:
+        idx = jnp.sum(jnp.asarray(step) >= bounds)
+        return vals[idx]
+
+    return schedule
+
+
+def exponential_decay(
+    base: float,
+    decay_rate: float,
+    decay_steps: int,
+    staircase: bool = False,
+) -> Schedule:
+    """``base * decay_rate ** (step / decay_steps)``."""
+
+    def schedule(step: jax.Array) -> jax.Array:
+        t = jnp.asarray(step, jnp.float32) / decay_steps
+        if staircase:
+            t = jnp.floor(t)
+        return base * (decay_rate**t)
+
+    return schedule
+
+
+def linear_warmup(base: float, warmup_steps: int) -> Schedule:
+    """Linear 0 -> base ramp over ``warmup_steps``, then constant (the
+    warmup used by the reference's example LR schedules,
+    examples/utils.py:92-114)."""
+
+    def schedule(step: jax.Array) -> jax.Array:
+        frac = jnp.minimum(jnp.asarray(step, jnp.float32) / max(1, warmup_steps), 1.0)
+        return base * frac
+
+    return schedule

@@ -163,13 +163,27 @@ def register_model(
             param_paths[name] = tuple(mod.path)
         return next_fun(*iargs, **ikwargs)
 
-    def probe(*a: Any, **kw: Any):
+    def is_traceable(v: Any) -> bool:
+        return hasattr(v, 'shape') and hasattr(v, 'dtype')
+
+    # Abstract exactly the array-like pytree leaves under eval_shape (so no
+    # real FLOPs/memory are spent), while non-array leaves (train=False
+    # flags etc.) stay static so model control flow on them works during
+    # the probe. Containers are handled per-leaf.
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    traced_positions = [i for i, leaf in enumerate(leaves) if is_traceable(leaf)]
+
+    def probe(traced_leaves):
+        full = list(leaves)
+        for pos, v in zip(traced_positions, traced_leaves):
+            full[pos] = v
+        full_args, full_kwargs = jax.tree_util.tree_unflatten(treedef, full)
         with nn.intercept_methods(interceptor):
             if apply_fn is not None:
-                return apply_fn(*a, **kw)
-            return model.init(jax.random.PRNGKey(0), *a, **kw)
+                return apply_fn(*full_args, **full_kwargs)
+            return model.init(jax.random.PRNGKey(0), *full_args, **full_kwargs)
 
-    jax.eval_shape(probe, *args, **kwargs)
+    jax.eval_shape(probe, [leaves[i] for i in traced_positions])
     return Registry(layers=dict(found), param_paths=dict(param_paths))
 
 

@@ -1,0 +1,24 @@
+"""Model families: MLP, CIFAR/ImageNet ResNets, Transformer LM."""
+
+from kfac_tpu.models.mlp import MLP
+from kfac_tpu.models.resnet import (
+    CifarResNet,
+    ImageNetResNet,
+    resnet20,
+    resnet32,
+    resnet50,
+    resnet56,
+)
+from kfac_tpu.models.transformer import TransformerLM, lm_loss
+
+__all__ = [
+    'MLP',
+    'CifarResNet',
+    'ImageNetResNet',
+    'TransformerLM',
+    'lm_loss',
+    'resnet20',
+    'resnet32',
+    'resnet50',
+    'resnet56',
+]

@@ -1,0 +1,23 @@
+"""Configurable MLP (smallest supported model family)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+
+
+class MLP(nn.Module):
+    """Dense stack with ReLU, the flax analogue of the reference's small
+    test/demo networks (testing/models.py)."""
+
+    features: Sequence[int] = (128, 128)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.reshape(x.shape[0], -1)
+        for i, f in enumerate(self.features):
+            x = nn.relu(nn.Dense(f, name=f'dense{i}')(x))
+        return nn.Dense(self.num_classes, name='head')(x)

@@ -68,21 +68,31 @@ def fill_triu(shape: tuple[int, int], triu: jax.Array) -> jax.Array:
     return out + lower
 
 
-def concat_flat(tensors: list[jax.Array]) -> tuple[jax.Array, list[tuple[tuple[int, ...], int]]]:
+def concat_flat(
+    tensors: list[jax.Array],
+) -> tuple[jax.Array, list[tuple[tuple[int, ...], int, jnp.dtype]]]:
     """Flatten+concat tensors into one buffer (explicit fusion for DCN-bound
     collectives; the XLA analogue of the reference's 25MB allreduce buckets,
-    kfac/distributed.py:305-374). Returns the buffer and (shape, size) specs
-    for :func:`split_flat`."""
-    specs = [(t.shape, int(t.size)) for t in tensors]
+    kfac/distributed.py:305-374). Mixed dtypes promote in the buffer and are
+    cast back by :func:`split_flat`; pack same-dtype groups when transport
+    bytes matter. Returns the buffer and (shape, size, dtype) specs."""
+    specs = [(t.shape, int(t.size), t.dtype) for t in tensors]
     flat = jnp.concatenate([t.reshape(-1) for t in tensors]) if tensors else jnp.zeros((0,))
     return flat, specs
 
 
-def split_flat(flat: jax.Array, specs: list[tuple[tuple[int, ...], int]]) -> list[jax.Array]:
-    """Inverse of :func:`concat_flat`."""
+def split_flat(
+    flat: jax.Array,
+    specs: list[tuple[tuple[int, ...], int, jnp.dtype]],
+) -> list[jax.Array]:
+    """Inverse of :func:`concat_flat` (restores shapes and dtypes)."""
     out = []
     offset = 0
-    for shape, size in specs:
-        out.append(jax.lax.dynamic_slice_in_dim(flat, offset, size).reshape(shape))
+    for shape, size, dtype in specs:
+        out.append(
+            jax.lax.dynamic_slice_in_dim(flat, offset, size)
+            .reshape(shape)
+            .astype(dtype)
+        )
         offset += size
     return out

@@ -118,6 +118,12 @@ class DistributedKFAC:
             grad_worker_fraction=self.grad_workers / self.world,
         )
         self._eigen = self.config.compute_method == enums.ComputeMethod.EIGEN
+        if self.config.prediv_eigenvalues:
+            raise NotImplementedError(
+                'prediv_eigenvalues is not supported by the stacked '
+                'distributed engine yet; use the dense KFACPreconditioner '
+                'or disable it'
+            )
 
     # ------------------------------------------------------------ shardings
 
@@ -404,6 +410,11 @@ class DistributedKFAC:
         new_grads = self.precondition(state, grads)
         state = state._replace(step=state.step + 1)
         return state, new_grads
+
+    def rematerialize(self, state: DistKFACState) -> DistKFACState:
+        """Recompute decompositions from factors after a checkpoint restore
+        (reference semantics: kfac/base_preconditioner.py:296-308)."""
+        return self.update_inverses(state)
 
     def memory_usage(self, state: DistKFACState) -> dict[str, int]:
         """Per-device bytes by category, accounting for sharded layouts."""

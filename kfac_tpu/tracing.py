@@ -1,0 +1,82 @@
+"""Opt-in timing/tracing instrumentation.
+
+Counterpart of the reference's tracing module (kfac/tracing.py:19-108).
+Differences forced by the execution model: JAX dispatch is async, so honest
+wall times require blocking on the traced function's outputs —
+``sync=True`` calls ``jax.block_until_ready`` (the role the reference's
+``dist.barrier`` plays for honest distributed timings). For on-device
+profiling, stages are additionally wrapped in ``jax.named_scope`` so they
+are attributable in XLA profiler traces.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from typing import Any, Callable, TypeVar
+
+import jax
+
+F = TypeVar('F', bound=Callable[..., Any])
+
+_func_traces: dict[str, list[float]] = {}
+
+logger = logging.getLogger(__name__)
+
+
+def clear_trace() -> None:
+    """Drop all recorded timings (reference kfac/tracing.py:19)."""
+    _func_traces.clear()
+
+
+def trace(sync: bool = False, name: str | None = None) -> Callable[[F], F]:
+    """Decorator recording wall times of each call into a global table.
+
+    Args:
+        sync: block on the function's jax outputs before stopping the clock
+            (async dispatch otherwise makes times meaningless).
+        name: override the recorded name (defaults to the function name).
+    """
+
+    def decorator(func: F) -> F:
+        key = name or func.__name__
+
+        @functools.wraps(func)
+        def wrapped(*args: Any, **kwargs: Any):
+            start = time.perf_counter()
+            with jax.named_scope(key):
+                out = func(*args, **kwargs)
+            if sync:
+                out = jax.block_until_ready(out)
+            _func_traces.setdefault(key, []).append(time.perf_counter() - start)
+            return out
+
+        return wrapped  # type: ignore[return-value]
+
+    return decorator
+
+
+def get_trace(
+    average: bool = True,
+    max_history: int | None = None,
+) -> dict[str, float]:
+    """Return recorded times per function, averaged or summed over a bounded
+    history (reference kfac/tracing.py:24-47)."""
+    out: dict[str, float] = {}
+    for key, times in _func_traces.items():
+        window = times[-max_history:] if max_history is not None else times
+        if not window:
+            continue
+        out[key] = sum(window) / len(window) if average else sum(window)
+    return out
+
+
+def log_trace(
+    level: int = logging.INFO,
+    label: str = 'timing:',
+    **kwargs: Any,
+) -> None:
+    """Log the trace table (reference kfac/tracing.py:50-71)."""
+    for key, value in sorted(get_trace(**kwargs).items()):
+        logger.log(level, f'{label} {key}: {value:.6f}s')

@@ -108,3 +108,18 @@ def test_unsupported_conv_variants_not_registered():
     from kfac_tpu.layers import registry as _r
     reg = _r.register_model(Net(), jnp.ones((1, 8, 8, 2)))
     assert set(reg.names()) == {'ok'}
+
+
+def test_register_with_container_batch_arg():
+    """Arrays nested in tuple/dict args are abstracted per-leaf (no real
+    init compute at registration time)."""
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, batch, train=False):
+            x = batch['x']
+            return nn.Dense(4, name='d')(x)
+
+    reg = registry.register_model(
+        Net(), {'x': jnp.ones((2, 5))}, train=False
+    )
+    assert set(reg.names()) == {'d'}

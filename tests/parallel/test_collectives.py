@@ -100,3 +100,11 @@ def test_concat_split_roundtrip():
     back = collectives.split_flat(flat, specs)
     for orig, rec in zip(tensors, back):
         np.testing.assert_array_equal(np.asarray(orig), np.asarray(rec))
+
+
+def test_concat_split_restores_mixed_dtypes():
+    tensors = [jnp.ones((2, 2), jnp.bfloat16), jnp.ones((3,), jnp.float32)]
+    flat, specs = collectives.concat_flat(tensors)
+    back = collectives.split_flat(flat, specs)
+    assert back[0].dtype == jnp.bfloat16
+    assert back[1].dtype == jnp.float32

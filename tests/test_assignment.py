@@ -198,3 +198,11 @@ def test_greedy_balance_quality():
             loads[kaisa.inv_worker(layer, f)] += work[layer][f]
     mean = sum(loads) / len(loads)
     assert max(loads) < 2.0 * mean
+
+
+def test_small_nonzero_fraction_rejected():
+    """Fractions that are neither 0 nor produce an integer count must raise
+    (a typo like 0.05 for 0.5 should not silently become MEM-OPT)."""
+    with pytest.raises(ValueError):
+        assignment.grad_worker_count(8, 0.05)
+    assert assignment.grad_worker_count(8, 0.0) == 1

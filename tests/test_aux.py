@@ -1,0 +1,171 @@
+"""Tests for auxiliary subsystems: schedules, tracing, checkpointing."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import kfac_tpu
+from kfac_tpu import checkpoint, hyperparams, tracing
+from testing import models
+
+
+# ----------------------------------------------------------------- schedules
+
+
+def test_exp_decay_factor_averaging_values():
+    sched = hyperparams.exp_decay_factor_averaging()
+    # reference values (kfac/hyperparams.py): step 0 -> treated as 1 -> 0;
+    # step 2 -> 0.5; step 100 -> capped at 0.95
+    assert float(sched(jnp.asarray(0))) == 0.0
+    assert float(sched(jnp.asarray(2))) == 0.5
+    np.testing.assert_allclose(float(sched(jnp.asarray(10))), 0.9)
+    assert float(sched(jnp.asarray(1000))) == pytest.approx(0.95)
+
+
+def test_exp_decay_rejects_bad_min():
+    with pytest.raises(ValueError):
+        hyperparams.exp_decay_factor_averaging(0.0)
+
+
+def test_lambda_schedule_composes():
+    sched = hyperparams.lambda_schedule(0.1, lambda s: 0.5 ** (s // 10))
+    assert float(sched(jnp.asarray(0))) == pytest.approx(0.1)
+    assert float(sched(jnp.asarray(20))) == pytest.approx(0.025)
+
+
+def test_piecewise_constant():
+    sched = hyperparams.piecewise_constant([10, 20], [1.0, 0.1, 0.01])
+    assert float(sched(jnp.asarray(5))) == pytest.approx(1.0)
+    assert float(sched(jnp.asarray(10))) == pytest.approx(0.1)
+    assert float(sched(jnp.asarray(25))) == pytest.approx(0.01)
+
+
+def test_schedules_work_inside_jit_as_hyperparams():
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg,
+        factor_decay=hyperparams.exp_decay_factor_averaging(),
+        damping=hyperparams.exponential_decay(0.01, 0.5, 100),
+        kl_clip=None,
+    )
+    cap = kfac_tpu.CurvatureCapture(reg)
+    run = cap.value_stats_and_grad(models.mse_loss(m))
+    state = kfac.init()
+    (_, _), grads, stats = run(params, (x, y))
+    state, pg = jax.jit(kfac.step)(state, grads, stats)
+    assert bool(jnp.isfinite(pg['fc1']['kernel']).all())
+
+
+# ------------------------------------------------------------------- tracing
+
+
+def test_trace_records_and_averages():
+    tracing.clear_trace()
+
+    @tracing.trace(sync=True)
+    def work(x):
+        return jnp.sum(x * x)
+
+    for _ in range(3):
+        work(jnp.arange(100.0))
+    t = tracing.get_trace()
+    assert 'work' in t and t['work'] > 0
+    total = tracing.get_trace(average=False)
+    assert total['work'] >= t['work']
+    bounded = tracing.get_trace(max_history=1)
+    assert bounded['work'] > 0
+    tracing.clear_trace()
+    assert tracing.get_trace() == {}
+
+
+def test_log_trace(caplog):
+    tracing.clear_trace()
+
+    @tracing.trace(name='custom')
+    def f():
+        return 1
+
+    f()
+    with caplog.at_level(logging.INFO, logger='kfac_tpu.tracing'):
+        tracing.log_trace()
+    assert any('custom' in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------- checkpoint
+
+
+def _train_a_bit(kfac, reg, m, params, batch, steps=3):
+    cap = kfac_tpu.CurvatureCapture(reg)
+    run = cap.value_stats_and_grad(models.mse_loss(m))
+    state = kfac.init()
+    for _ in range(steps):
+        (_, _), grads, stats = run(params, batch)
+        state, pg = kfac.step(state, grads, stats)
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.05 * g, params, pg)
+    return state, params, grads, stats
+
+
+def test_checkpoint_roundtrip_dense(tmp_path):
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg, kl_clip=None)
+    state, params, grads, stats = _train_a_bit(kfac, reg, m, params, (x, y))
+
+    path = str(tmp_path / 'ckpt')
+    checkpoint.save(path, state, extra={'params': params})
+    restored, extra = checkpoint.restore(path, kfac, extra_template={'params': params})
+    assert int(restored.step) == int(state.step)
+    np.testing.assert_allclose(
+        np.asarray(restored.a['fc1']), np.asarray(state.a['fc1']), rtol=1e-6
+    )
+    # decompositions were rematerialized, preconditioning matches
+    p1 = kfac.precondition(state, grads)
+    p2 = kfac.precondition(restored, grads)
+    np.testing.assert_allclose(
+        np.asarray(p1['fc1']['kernel']), np.asarray(p2['fc1']['kernel']),
+        rtol=1e-4, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(extra['params']['fc1']['kernel']),
+        np.asarray(params['fc1']['kernel']),
+    )
+
+
+def test_checkpoint_roundtrip_distributed(tmp_path):
+    from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+
+    mesh = kaisa_mesh(grad_worker_fraction=0.5)
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=64)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    cfg = kfac_tpu.KFACPreconditioner(registry=reg, kl_clip=None)
+    dk = DistributedKFAC(config=cfg, mesh=mesh)
+    cap = kfac_tpu.CurvatureCapture(reg)
+    run = cap.value_stats_and_grad(models.mse_loss(m))
+    state = dk.init()
+    (_, _), grads, stats = run(params, (x, y))
+    state, _ = jax.jit(dk.step)(state, grads, stats)
+
+    path = str(tmp_path / 'dckpt')
+    checkpoint.save(path, state)
+    restored, _ = checkpoint.restore(path, dk)
+    assert int(restored.step) == 1
+    key = dk.buckets[0].key
+    np.testing.assert_allclose(
+        np.asarray(restored.a[key]), np.asarray(state.a[key]), rtol=1e-6
+    )
+    p1 = dk.precondition(state, grads)
+    p2 = dk.precondition(restored, grads)
+    np.testing.assert_allclose(
+        np.asarray(p1['fc1']['kernel']), np.asarray(p2['fc1']['kernel']),
+        rtol=1e-4, atol=1e-6,
+    )
