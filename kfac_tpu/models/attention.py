@@ -1,0 +1,161 @@
+"""Attention kernels: dense causal and ring (context-parallel) attention.
+
+Ring attention shards the sequence axis over a mesh axis and rotates K/V
+blocks around the ring with ``ppermute`` while accumulating output in the
+numerically-stable blockwise-softmax (flash) form. This gives
+sequence-length scaling the reference framework does not have (SURVEY.md
+section 2.3 lists SP/CP as absent) with communication that rides the ICI
+ring — each step overlaps a block matmul with the next block's transfer.
+
+Causal runs skip fully-masked (above-diagonal) blocks entirely. The ring is
+still lockstep, so the tail shard's diagonal-heavy load bounds wall clock;
+zigzag position striping plus a block-sparse Pallas kernel is the planned
+next level.
+
+Matmuls accumulate in fp32 (``preferred_element_type``); inputs may be bf16.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def dense_causal_attention(q, k, v):
+    """Reference single-device attention: (B, S, H, D) -> (B, S, H, D)."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        'bqhd,bkhd->bhqk', q * scale, k, preferred_element_type=jnp.float32
+    )
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum(
+        'bhqk,bkhd->bqhd', probs, v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype)
+
+
+def _block_attend(q, k, v, q_offset, k_offset, causal):
+    """Unnormalized blockwise attention: returns (acc, row_max, row_sum)."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        'bqhd,bkhd->bhqk', q * scale, k, preferred_element_type=jnp.float32
+    )
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(sq)
+        k_pos = k_offset + jnp.arange(sk)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)  # (B,H,Q)
+    p = jnp.exp(logits - m[..., None])
+    # fully-masked rows: exp(NEG_INF - NEG_INF) = 1 would poison the sum
+    p = jnp.where(logits <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum(
+        'bhqk,bkhd->bqhd', p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return acc, m, l
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Context-parallel attention inside ``shard_map``.
+
+    Args:
+        q, k, v: local sequence shards (B, S_local, H, D); the global
+            sequence is sharded over ``axis_name`` in ring order.
+        axis_name: mesh axis carrying the sequence shards.
+        causal: apply a causal mask in *global* positions.
+
+    Returns (B, S_local, H, D): this shard's rows of the attention output,
+    exactly equal to the dense computation on the gathered sequence.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    q_offset = my * s_local
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def merge(carry, blk):
+        acc, m, l = carry
+        blk_acc, blk_m, blk_l = blk
+        new_m = jnp.maximum(m, blk_m)
+        scale_old = jnp.exp(m - new_m)
+        scale_blk = jnp.exp(blk_m - new_m)
+        l = l * scale_old + blk_l * scale_blk
+        acc = (
+            acc * scale_old.transpose(0, 2, 1)[..., None]
+            + blk_acc * scale_blk.transpose(0, 2, 1)[..., None]
+        )
+        return acc, new_m, l
+
+    # Iteration 0 (own block) runs outside the loop so K/V rotate only
+    # n-1 times; later iterations rotate at the top of the body.
+    carry0 = _block_attend(q, k, v, q_offset, q_offset, causal)
+
+    def body(i, state):
+        acc, m, l, k_cur, v_cur = state
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (my - i) % n
+        k_offset = src * s_local
+
+        def attend(_):
+            blk = _block_attend(q, k_cur, v_cur, q_offset, k_offset, causal)
+            return merge((acc, m, l), blk)
+
+        if causal:
+            # blocks strictly above the diagonal are fully masked: skip the
+            # matmuls entirely (predicate is device-local; no collectives in
+            # either branch)
+            acc, m, l = jax.lax.cond(
+                src > my, lambda _: (acc, m, l), attend, operand=None
+            )
+        else:
+            acc, m, l = attend(None)
+        return acc, m, l, k_cur, v_cur
+
+    acc, m, l, _, _ = jax.lax.fori_loop(
+        1, n, body, (*carry0, k, v)
+    )
+    # fully-masked rows (none under causal self-attention) guard
+    denom = jnp.where(l == 0.0, 1.0, l)
+    out = acc / denom.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_context_parallel_attention(mesh, axis_name: str, causal: bool = True):
+    """shard_map-wrapped ring attention over global (B, S, H, D) arrays.
+
+    Besides the sequence axis, the batch dim stays sharded over any
+    data-parallel axes present in the mesh and heads over a model axis (if
+    the head count divides it) — ring attention must not undo data/tensor
+    parallelism.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from kfac_tpu.parallel import mesh as mesh_lib
+
+    batch_axes = tuple(a for a in mesh_lib.DATA_AXES if a in mesh.shape)
+    head_axis = (
+        mesh_lib.MODEL_AXIS
+        if mesh_lib.MODEL_AXIS in mesh.shape and mesh.shape[mesh_lib.MODEL_AXIS] > 1
+        else None
+    )
+    spec = P(batch_axes or None, axis_name, head_axis, None)
+
+    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )

@@ -20,11 +20,22 @@ import jax.numpy as jnp
 
 
 class CausalSelfAttention(nn.Module):
+    """Causal attention with optional context parallelism.
+
+    With ``ring_mesh``/``ring_axis`` set, attention runs as ring attention
+    over the sequence-sharded mesh axis (kfac_tpu/models/attention.py);
+    otherwise a dense fused path is used.
+    """
+
     num_heads: int
     dtype: Any = jnp.float32
+    ring_mesh: Any = None
+    ring_axis: str | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        from kfac_tpu.models import attention as attention_lib
+
         d = x.shape[-1]
         head_dim = d // self.num_heads
         q = nn.Dense(d, dtype=self.dtype, name='q_proj')(x)
@@ -35,13 +46,12 @@ class CausalSelfAttention(nn.Module):
             return t.reshape(*t.shape[:-1], self.num_heads, head_dim)
 
         q, k, v = split(q), split(k), split(v)
-        scale = head_dim**-0.5
-        logits = jnp.einsum('...qhd,...khd->...hqk', q * scale, k)
-        seq = x.shape[-2]
-        mask = jnp.tril(jnp.ones((seq, seq), dtype=bool))
-        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
-        out = jnp.einsum('...hqk,...khd->...qhd', probs, v)
+        if self.ring_axis is not None:
+            out = attention_lib.make_context_parallel_attention(
+                self.ring_mesh, self.ring_axis, causal=True
+            )(q, k, v)
+        else:
+            out = attention_lib.dense_causal_attention(q, k, v)
         out = out.reshape(*x.shape[:-1], d)
         return nn.Dense(d, dtype=self.dtype, name='out_proj')(out)
 
@@ -50,12 +60,17 @@ class Block(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     dtype: Any = jnp.float32
+    ring_mesh: Any = None
+    ring_axis: str | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         d = x.shape[-1]
         y = nn.LayerNorm(dtype=jnp.float32, name='ln1')(x)
-        x = x + CausalSelfAttention(self.num_heads, dtype=self.dtype, name='attn')(y)
+        x = x + CausalSelfAttention(
+            self.num_heads, dtype=self.dtype, ring_mesh=self.ring_mesh,
+            ring_axis=self.ring_axis, name='attn',
+        )(y)
         y = nn.LayerNorm(dtype=jnp.float32, name='ln2')(x)
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype, name='mlp_up')(y)
         h = nn.gelu(h)
@@ -78,6 +93,8 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dtype: Any = jnp.float32
     remat: bool = False
+    ring_mesh: Any = None
+    ring_axis: str | None = None
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
@@ -95,6 +112,7 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = block_cls(
                 self.num_heads, self.mlp_ratio, dtype=self.dtype,
+                ring_mesh=self.ring_mesh, ring_axis=self.ring_axis,
                 name=f'block{i}',
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name='ln_f')(x.astype(jnp.float32))

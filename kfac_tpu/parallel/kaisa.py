@@ -104,12 +104,17 @@ class DistributedKFAC:
 
     def __post_init__(self) -> None:
         self.registry = self.config.registry
-        self.world = mesh_lib.world_size(self.mesh)
+        # The KAISA strategy grid is the data-parallel mesh portion, but the
+        # eigendecomposition work and factor storage shard over EVERY mesh
+        # axis — model/seq-parallel devices pull their weight too.
+        self.world = mesh_lib.grad_workers(self.mesh) * mesh_lib.n_cols(self.mesh)
         self.grad_workers = mesh_lib.grad_workers(self.mesh)
+        self.all_axes = tuple(self.mesh.axis_names)
+        self.total_devices = int(self.mesh.devices.size)
         self.strategy = assignment_lib.strategy_for_fraction(
             self.world, self.grad_workers / self.world
         )
-        self.buckets = build_buckets(self.registry, self.world)
+        self.buckets = build_buckets(self.registry, self.total_devices)
         # Parity object: cost-model view of the placement for reporting and
         # for API compatibility with the reference's query surface.
         self.assignment = assignment_lib.KAISAAssignment(
@@ -128,9 +133,9 @@ class DistributedKFAC:
     # ------------------------------------------------------------ shardings
 
     def _factor_spec(self) -> P:
-        """Factors live sharded over the whole mesh (their only consumer is
+        """Factors live sharded over every mesh axis (their only consumer is
         the device that decomposes them)."""
-        return P(mesh_lib.DATA_AXES)
+        return P(self.all_axes)
 
     def _decomp_spec(self) -> P:
         """Resident layout of decompositions: the KAISA strategy knob."""
@@ -266,7 +271,7 @@ class DistributedKFAC:
             d, q = jnp.linalg.eigh(block.astype(jnp.float32))
             return q, jnp.clip(d, 0.0)
 
-        spec = P(mesh_lib.DATA_AXES)
+        spec = P(self.all_axes)
         q, d = jax.shard_map(
             local,
             mesh=self.mesh,
@@ -283,7 +288,7 @@ class DistributedKFAC:
             return jax.vmap(lambda m: jax.scipy.linalg.cho_solve(
                 jax.scipy.linalg.cho_factor(m), eye))(fd)
 
-        spec = P(mesh_lib.DATA_AXES)
+        spec = P(self.all_axes)
         return jax.shard_map(
             local, mesh=self.mesh, in_specs=spec, out_specs=spec
         )(stack)
@@ -418,7 +423,7 @@ class DistributedKFAC:
 
     def memory_usage(self, state: DistKFACState) -> dict[str, int]:
         """Per-device bytes by category, accounting for sharded layouts."""
-        shard_f = 1.0 / self.world
+        shard_f = 1.0 / self.total_devices
         if self.strategy == enums.DistributedStrategy.COMM_OPT:
             shard_d = 1.0
         else:

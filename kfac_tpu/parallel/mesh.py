@@ -23,6 +23,8 @@ from kfac_tpu import assignment as assignment_lib
 GW_AXIS = 'kfac_gw'
 COL_AXIS = 'kfac_col'
 DATA_AXES = (GW_AXIS, COL_AXIS)
+MODEL_AXIS = 'model'
+SEQ_AXIS = 'seq'
 
 
 def kaisa_mesh(
@@ -41,8 +43,46 @@ def kaisa_mesh(
     return Mesh(grid, (GW_AXIS, COL_AXIS))
 
 
+def train_mesh(
+    grad_worker_fraction: float = 1.0,
+    model: int = 1,
+    seq: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a 4-axis training mesh (kfac_gw, kfac_col, model, seq).
+
+    The data-parallel world is the KAISA grid (first two axes); ``model``
+    shards tensor-parallel weights (the reference's Megatron-style
+    Column/RowParallelLinear dimension, kfac/gpt_neox/preconditioner.py:
+    481-502); ``seq`` shards the sequence dimension for context parallelism
+    / ring attention — a capability the reference lacks (SURVEY.md section
+    2.3). K-FAC state specs name only the KAISA axes, so second-order state
+    is automatically replicated over model/seq.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    world = len(devices)
+    if world % (model * seq) != 0:
+        raise ValueError(
+            f'{world} devices not divisible by model*seq = {model * seq}'
+        )
+    dp = world // (model * seq)
+    workers = assignment_lib.grad_worker_count(dp, grad_worker_fraction)
+    grid = np.asarray(devices, dtype=object).reshape(
+        workers, dp // workers, model, seq
+    )
+    return Mesh(grid, (GW_AXIS, COL_AXIS, MODEL_AXIS, SEQ_AXIS))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading batch dim over every device (pure data parallel)."""
+    return NamedSharding(mesh, P(DATA_AXES))
+
+
+def token_sharding(mesh: Mesh) -> NamedSharding:
+    """(batch, seq, ...) arrays: batch over the data axes, sequence over the
+    seq axis (no-op when the mesh has no seq axis)."""
+    if SEQ_AXIS in mesh.shape:
+        return NamedSharding(mesh, P(DATA_AXES, SEQ_AXIS))
     return NamedSharding(mesh, P(DATA_AXES))
 
 
