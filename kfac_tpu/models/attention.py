@@ -132,24 +132,32 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     return out.astype(q.dtype)
 
 
-def make_context_parallel_attention(mesh, axis_name: str, causal: bool = True):
+def make_context_parallel_attention(
+    mesh,
+    axis_name: str,
+    causal: bool = True,
+    num_heads: int | None = None,
+):
     """shard_map-wrapped ring attention over global (B, S, H, D) arrays.
 
     Besides the sequence axis, the batch dim stays sharded over any
-    data-parallel axes present in the mesh and heads over a model axis (if
-    the head count divides it) — ring attention must not undo data/tensor
-    parallelism.
+    data-parallel axes present in the mesh and heads over a model axis when
+    ``num_heads`` is given and divisible by it (otherwise heads replicate) —
+    ring attention must not undo data/tensor parallelism.
     """
     from jax.sharding import PartitionSpec as P
 
     from kfac_tpu.parallel import mesh as mesh_lib
 
     batch_axes = tuple(a for a in mesh_lib.DATA_AXES if a in mesh.shape)
-    head_axis = (
-        mesh_lib.MODEL_AXIS
-        if mesh_lib.MODEL_AXIS in mesh.shape and mesh.shape[mesh_lib.MODEL_AXIS] > 1
-        else None
-    )
+    head_axis = None
+    if (
+        mesh_lib.MODEL_AXIS in mesh.shape
+        and mesh.shape[mesh_lib.MODEL_AXIS] > 1
+        and num_heads is not None
+        and num_heads % mesh.shape[mesh_lib.MODEL_AXIS] == 0
+    ):
+        head_axis = mesh_lib.MODEL_AXIS
     spec = P(batch_axes or None, axis_name, head_axis, None)
 
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)

@@ -48,7 +48,8 @@ class CausalSelfAttention(nn.Module):
         q, k, v = split(q), split(k), split(v)
         if self.ring_axis is not None:
             out = attention_lib.make_context_parallel_attention(
-                self.ring_mesh, self.ring_axis, causal=True
+                self.ring_mesh, self.ring_axis, causal=True,
+                num_heads=self.num_heads,
             )(q, k, v)
         else:
             out = attention_lib.dense_causal_attention(q, k, v)

@@ -1,16 +1,29 @@
-"""Distributed execution: meshes, collectives, KAISA sharded engine."""
+"""Distributed execution: meshes, collectives, KAISA/TP/CP/PP engines."""
 
-from kfac_tpu.parallel import collectives, mesh
+from kfac_tpu.parallel import collectives, mesh, pipeline, tensor_parallel
 from kfac_tpu.parallel.kaisa import DistKFACState, DistributedKFAC, build_buckets
-from kfac_tpu.parallel.mesh import batch_sharding, kaisa_mesh, replicated
+from kfac_tpu.parallel.mesh import (
+    batch_sharding,
+    kaisa_mesh,
+    replicated,
+    token_sharding,
+    train_mesh,
+)
+from kfac_tpu.parallel.pipeline import PipelinedLM, PipelineKFAC
 
 __all__ = [
     'DistKFACState',
     'DistributedKFAC',
+    'PipelineKFAC',
+    'PipelinedLM',
     'batch_sharding',
     'build_buckets',
     'collectives',
     'kaisa_mesh',
     'mesh',
+    'pipeline',
     'replicated',
+    'tensor_parallel',
+    'token_sharding',
+    'train_mesh',
 ]

@@ -56,8 +56,10 @@ def train_mesh(
     Column/RowParallelLinear dimension, kfac/gpt_neox/preconditioner.py:
     481-502); ``seq`` shards the sequence dimension for context parallelism
     / ring attention — a capability the reference lacks (SURVEY.md section
-    2.3). K-FAC state specs name only the KAISA axes, so second-order state
-    is automatically replicated over model/seq.
+    2.3). The KAISA strategy grid (worker fraction, gather layouts) is the
+    first two axes; factor storage and eigendecomposition work additionally
+    shard over model/seq (see DistributedKFAC._factor_spec), while
+    decomposition resident layouts replicate over them.
     """
     devices = list(devices if devices is not None else jax.devices())
     world = len(devices)

@@ -14,7 +14,6 @@ import kfac_tpu
 from kfac_tpu.models import TransformerLM, lm_loss
 from kfac_tpu.parallel import (
     DistributedKFAC,
-    kaisa_mesh,
     tensor_parallel,
 )
 from kfac_tpu.parallel import mesh as mesh_lib

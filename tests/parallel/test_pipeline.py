@@ -1,0 +1,138 @@
+"""Pipeline-parallel LM + K-FAC tests (GPipe schedule over a pipe axis).
+
+Behavioral targets: the reference's GPT-NeoX pipeline e2e suite
+(tests/gpt_neox/gpt_preconditioner_test.py: preconditioner over pipeline
+stages {1,2,4}) — here the schedule itself is also validated against an
+unpipelined sequential application of the same stage weights.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import kfac_tpu
+from kfac_tpu.parallel import pipeline
+
+
+def _mesh(n_stages):
+    return Mesh(
+        np.asarray(jax.devices()[:n_stages]).reshape(n_stages), ('pipe',)
+    )
+
+
+def _model(n_stages, num_layers=4, micro=4, d=32):
+    return pipeline.PipelinedLM(
+        mesh=_mesh(n_stages),
+        vocab_size=64,
+        d_model=d,
+        num_heads=4,
+        num_layers=num_layers,
+        n_microbatches=micro,
+        max_len=16,
+    )
+
+
+def _sequential_logits(model, params, tokens):
+    """Oracle: apply stages one after another without the pipeline."""
+    x = model._embed(params, tokens)
+    for s in range(model.n_stages):
+        sp = jax.tree_util.tree_map(lambda v: v[s], params['stages'])
+        x = model.stage.apply({'params': sp}, x)
+    x = model.ln_f.apply({'params': params['ln_f']}, x.astype(jnp.float32))
+    return model.head.apply({'params': params['head']}, x)
+
+
+@pytest.mark.parametrize('n_stages,layers', [(1, 2), (2, 4), (4, 4)])
+def test_pipeline_forward_matches_sequential(n_stages, layers):
+    model = _model(n_stages, num_layers=layers)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
+    params = model.init(jax.random.PRNGKey(1))
+    logits, a_stats, counts = jax.jit(model.apply)(params, tokens)
+    expected = _sequential_logits(model, params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(expected), rtol=2e-3, atol=2e-4
+    )
+    # every stage processed all microbatches
+    np.testing.assert_allclose(np.asarray(counts), model.n_microbatches)
+    for name, h in model.stage_registry.layers.items():
+        assert a_stats[name].shape == (n_stages,) + h.a_factor_shape
+
+
+def test_pipeline_stats_match_dense_capture():
+    """Stage-stacked A/G stats must equal the dense interceptor capture on
+    the equivalent unpipelined model (single stage)."""
+    model = _model(1, num_layers=2, micro=2)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 64)
+    params = model.init(jax.random.PRNGKey(1))
+    targets = jnp.roll(tokens, -1, 1)
+    loss, grads, stats = model.loss_and_stats(params, (tokens, targets))
+
+    # dense oracle: same computation as a flat flax model via the standard
+    # capture machinery
+    def flat_loss(stage_params, batch):
+        tk, tg = batch
+        x = model._embed(params, tk)
+        x = model.stage.apply({'params': stage_params}, x)
+        x = model.ln_f.apply({'params': params['ln_f']}, x.astype(jnp.float32))
+        logits = model.head.apply({'params': params['head']}, x)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(logp, tg[..., None], -1))
+
+    cap = kfac_tpu.CurvatureCapture(model.stage_registry)
+    sp0 = jax.tree_util.tree_map(lambda v: v[0], params['stages'])
+    (loss0, _), grads0, stats0 = cap.value_stats_and_grad(flat_loss)(
+        sp0, (tokens, targets)
+    )
+    np.testing.assert_allclose(float(loss), float(loss0), rtol=1e-5)
+    for name in stats0.a:
+        np.testing.assert_allclose(
+            np.asarray(stats.a[name][0]), np.asarray(stats0.a[name]),
+            rtol=1e-3, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats.g[name][0]), np.asarray(stats0.g[name]),
+            rtol=1e-3, atol=1e-6,
+        )
+    # stage grads match too
+    np.testing.assert_allclose(
+        np.asarray(
+            grads['stages']['block0']['attn']['q_proj']['kernel'][0]
+        ),
+        np.asarray(grads0['block0']['attn']['q_proj']['kernel']),
+        rtol=1e-3, atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize('n_stages', [2, 4])
+def test_pipeline_kfac_training(n_stages):
+    model = _model(n_stages, num_layers=4, micro=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
+    targets = jnp.roll(tokens, -1, 1)
+    params = model.init(jax.random.PRNGKey(1))
+    cfg = kfac_tpu.KFACPreconditioner(
+        registry=model.stage_registry, damping=0.01, lr=0.1
+    )
+    pk = pipeline.PipelineKFAC(config=cfg, model=model)
+    state = pk.init()
+
+    @jax.jit
+    def train_step(params, state, batch):
+        loss, grads, stats = model.loss_and_stats(params, batch)
+        state, grads = pk.step(state, grads, stats)
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - 0.1 * g, params, grads
+        )
+        return params, state, loss
+
+    losses = []
+    for _ in range(6):
+        params, state, loss = train_step(params, state, (tokens, targets))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(losses))
+    assert int(state['step']) == 6
+    # stage factor state is actually sharded over pipe
+    key = next(iter(state['a']))
+    assert 'pipe' in str(state['a'][key].sharding.spec)
