@@ -1,0 +1,167 @@
+"""Shared example-trainer glue: flags, metrics, schedules, checkpoints.
+
+Parity with the reference's example utilities (examples/utils.py: Metric,
+accuracy, LabelSmoothLoss, create_lr_schedule; examples/vision/
+optimizers.py: the K-FAC flag surface).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import kfac_tpu
+
+
+def add_kfac_args(parser: argparse.ArgumentParser) -> None:
+    """The reference's K-FAC CLI surface
+    (examples/torch_cifar10_resnet.py:148-237)."""
+    g = parser.add_argument_group('kfac')
+    g.add_argument('--kfac', action='store_true', default=True)
+    g.add_argument('--no-kfac', dest='kfac', action='store_false')
+    g.add_argument('--kfac-factor-update-steps', type=int, default=10)
+    g.add_argument('--kfac-inv-update-steps', type=int, default=100)
+    g.add_argument('--kfac-damping', type=float, default=0.003)
+    g.add_argument('--kfac-factor-decay', type=float, default=0.95)
+    g.add_argument('--kfac-kl-clip', type=float, default=0.001)
+    g.add_argument(
+        '--kfac-compute-method', choices=('eigen', 'inverse'), default='eigen'
+    )
+    g.add_argument(
+        '--kfac-strategy',
+        choices=('comm-opt', 'mem-opt', 'hybrid-opt'),
+        default='comm-opt',
+        help='maps to grad_worker_fraction 1 / 1/world / 0.5',
+    )
+    g.add_argument('--kfac-skip-layers', nargs='*', default=[])
+
+
+def add_train_args(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group('training')
+    g.add_argument('--epochs', type=int, default=3)
+    g.add_argument('--batch-size', type=int, default=128)
+    g.add_argument('--lr', type=float, default=0.1)
+    g.add_argument('--momentum', type=float, default=0.9)
+    g.add_argument('--weight-decay', type=float, default=5e-4)
+    g.add_argument('--warmup-epochs', type=float, default=1)
+    g.add_argument('--lr-decay', nargs='*', type=float, default=[0.5, 0.75])
+    g.add_argument('--seed', type=int, default=42)
+    g.add_argument('--data-dir', default=None)
+    g.add_argument('--checkpoint-dir', default=None)
+    g.add_argument('--bf16', action='store_true')
+    g.add_argument('--limit-steps', type=int, default=None,
+                   help='cap steps per epoch (smoke runs)')
+
+
+def strategy_fraction(name: str, world: int) -> float:
+    if world < 1:
+        raise ValueError(
+            f'data-parallel world is {world}; model/seq shards exceed the '
+            'device count'
+        )
+    if name == 'mem-opt':
+        return 1.0 / world
+    return {'comm-opt': 1.0, 'hybrid-opt': 0.5}[name]
+
+
+def make_lr_schedule(base_lr, steps_per_epoch, epochs, warmup_epochs, decay_at):
+    """Warmup + stepwise decay (reference examples/utils.py:92-114)."""
+    boundaries = [int(d * epochs * steps_per_epoch) for d in decay_at]
+    warmup = int(warmup_epochs * steps_per_epoch)
+    piece = optax.piecewise_constant_schedule(
+        base_lr, {b: 0.1 for b in boundaries}
+    )
+
+    def schedule(step):
+        w = jnp.minimum(1.0, (step + 1) / max(1, warmup))
+        return piece(step) * w
+
+    return schedule
+
+
+def label_smoothing_loss(logits, labels, num_classes, smoothing=0.1):
+    """Label-smoothed cross entropy (reference examples/utils.py:41-63),
+    via the optax built-ins."""
+    soft = optax.smooth_labels(jax.nn.one_hot(labels, num_classes), smoothing)
+    return optax.softmax_cross_entropy(
+        logits.astype(jnp.float32), soft
+    ).mean()
+
+
+def cross_entropy_loss(logits, labels, num_classes):
+    del num_classes
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    ).mean()
+
+
+class Metric:
+    """Streaming average (the allreduce is implicit: metrics are computed on
+    global arrays; reference examples/utils.py:66-89)."""
+
+    def __init__(self) -> None:
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, value, n: int = 1) -> None:
+        self.total += float(value) * n
+        self.count += n
+
+    @property
+    def avg(self) -> float:
+        return self.total / max(1, self.count)
+
+
+def accuracy(logits, labels) -> float:
+    return float((jnp.argmax(logits, -1) == labels).mean())
+
+
+def build_kfac(args, registry, mesh=None):
+    """Construct the (distributed) preconditioner from CLI flags."""
+    if not args.kfac:
+        return None
+    cfg = kfac_tpu.KFACPreconditioner(
+        registry=registry,
+        factor_update_steps=args.kfac_factor_update_steps,
+        inv_update_steps=args.kfac_inv_update_steps,
+        damping=args.kfac_damping,
+        factor_decay=args.kfac_factor_decay,
+        kl_clip=args.kfac_kl_clip,
+        lr=args.lr,
+        compute_method=args.kfac_compute_method,
+    )
+    if mesh is not None:
+        from kfac_tpu.parallel import DistributedKFAC
+
+        return DistributedKFAC(config=cfg, mesh=mesh)
+    return cfg
+
+
+class Timer:
+    def __init__(self) -> None:
+        self.start = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.start
+
+
+def save_checkpoint(checkpoint_dir, state) -> None:
+    """Write params (always) and K-FAC factors (when enabled) via orbax."""
+    from kfac_tpu import checkpoint
+
+    if state.kfac_state is not None:
+        checkpoint.save(
+            checkpoint_dir + '/kfac', state.kfac_state,
+            extra={'params': state.params},
+        )
+    else:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(checkpoint_dir + '/params', {'params': state.params})
+        ckptr.wait_until_finished()
+    print(f'checkpoint written to {checkpoint_dir}')

@@ -1,0 +1,125 @@
+"""Datasets for the example trainers.
+
+The reference examples pull CIFAR/ImageNet/PennTreebank via torchvision /
+torchtext (examples/vision/datasets.py, examples/language/dataset.py). This
+environment has no network egress, so each loader here prefers an on-disk
+copy (``--data-dir`` with .npz files) and falls back to a deterministic
+synthetic dataset with the same shapes — the training dynamics (throughput,
+K-FAC behavior) are representative even when the labels are synthetic.
+sklearn's bundled digits dataset provides a real offline classification
+task for the integration gate.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _rng(seed: int) -> np.random.Generator:
+    return np.random.default_rng(seed)
+
+
+def synthetic_classification(
+    n: int, shape: tuple[int, ...], num_classes: int, seed: int = 0
+):
+    """Gaussian class-conditional images: learnable but synthetic."""
+    rng = _rng(seed)
+    labels = rng.integers(0, num_classes, size=n)
+    centers = rng.normal(size=(num_classes,) + shape).astype(np.float32)
+    x = 0.5 * centers[labels] + rng.normal(size=(n,) + shape).astype(np.float32)
+    return x.astype(np.float32), labels.astype(np.int32)
+
+
+def cifar10(data_dir: str | None = None, n_train: int = 50000, n_test: int = 10000):
+    """(32, 32, 3) x 10 classes; loads ``cifar10.npz`` from data_dir if
+    present (keys: x_train, y_train, x_test, y_test), else synthetic."""
+    if data_dir:
+        path = os.path.join(data_dir, 'cifar10.npz')
+        if os.path.exists(path):
+            z = np.load(path)
+            return (
+                (z['x_train'].astype(np.float32), z['y_train'].astype(np.int32)),
+                (z['x_test'].astype(np.float32), z['y_test'].astype(np.int32)),
+            )
+    train = synthetic_classification(n_train, (32, 32, 3), 10, seed=0)
+    test = synthetic_classification(n_test, (32, 32, 3), 10, seed=1)
+    return train, test
+
+
+def imagenet_like(
+    data_dir: str | None = None,
+    image_size: int = 224,
+    n_train: int = 10000,
+    n_test: int = 1000,
+    num_classes: int = 1000,
+):
+    """ImageNet-shaped data ((S, S, 3) x 1000)."""
+    if data_dir:
+        path = os.path.join(data_dir, 'imagenet.npz')
+        if os.path.exists(path):
+            z = np.load(path)
+            return (
+                (z['x_train'].astype(np.float32), z['y_train'].astype(np.int32)),
+                (z['x_test'].astype(np.float32), z['y_test'].astype(np.int32)),
+            )
+    shape = (image_size, image_size, 3)
+    train = synthetic_classification(n_train, shape, num_classes, seed=0)
+    test = synthetic_classification(n_test, shape, num_classes, seed=1)
+    return train, test
+
+
+def digits():
+    """sklearn's offline 8x8 digits (the MNIST-gate stand-in)."""
+    from sklearn.datasets import load_digits
+
+    x, y = load_digits(return_X_y=True)
+    x = (x / 16.0).astype(np.float32)
+    rng = _rng(0)
+    idx = rng.permutation(len(x))
+    x, y = x[idx], y[idx].astype(np.int32)
+    split = int(0.8 * len(x))
+    return (x[:split], y[:split]), (x[split:], y[split:])
+
+
+def lm_corpus(
+    data_dir: str | None = None,
+    vocab_size: int = 8192,
+    n_tokens: int = 2_000_000,
+    seed: int = 0,
+):
+    """Token stream: ``corpus.npy`` (int tokens) from data_dir if present,
+    else a Zipf-distributed synthetic stream (realistic softmax skew)."""
+    if data_dir:
+        path = os.path.join(data_dir, 'corpus.npy')
+        if os.path.exists(path):
+            toks = np.load(path).astype(np.int32)
+            return toks, int(toks.max()) + 1
+    rng = _rng(seed)
+    toks = rng.zipf(1.3, size=n_tokens).astype(np.int64)
+    toks = np.clip(toks, 1, vocab_size - 1).astype(np.int32)
+    return toks, vocab_size
+
+
+def batches(x, y, batch_size: int, seed: int, drop_last: bool = True):
+    """Shuffled epoch iterator (the DistributedSampler stand-in: under pjit
+    the global batch is sharded by device_put, not by per-rank sampling)."""
+    rng = _rng(seed)
+    idx = rng.permutation(len(x))
+    end = (len(x) // batch_size) * batch_size if drop_last else len(x)
+    for i in range(0, end, batch_size):
+        j = idx[i : i + batch_size]
+        yield x[j], y[j]
+
+
+def lm_batches(tokens, batch_size: int, seq_len: int, seed: int):
+    """Contiguous next-token-prediction windows."""
+    rng = _rng(seed)
+    n_windows = (len(tokens) - 1) // seq_len
+    starts = rng.permutation(n_windows)[: (n_windows // batch_size) * batch_size]
+    for i in range(0, len(starts), batch_size):
+        s = starts[i : i + batch_size] * seq_len
+        x = np.stack([tokens[a : a + seq_len] for a in s])
+        y = np.stack([tokens[a + 1 : a + seq_len + 1] for a in s])
+        yield x, y

@@ -1,0 +1,59 @@
+"""Example-trainer smoke tests (CLI surface, tiny synthetic runs)."""
+
+import sys
+
+import pytest
+
+
+def test_cifar_example_smoke(monkeypatch):
+    from examples import train_cifar_resnet
+
+    acc = train_cifar_resnet.main(
+        [
+            '--model', 'resnet20', '--epochs', '1', '--batch-size', '32',
+            '--limit-steps', '3', '--kfac-factor-update-steps', '1',
+            '--kfac-inv-update-steps', '1', '--kfac-strategy', 'hybrid-opt',
+        ]
+    )
+    assert 0.0 <= acc <= 1.0
+
+
+def test_lm_example_smoke():
+    from examples import train_language_model
+
+    ppl = train_language_model.main(
+        [
+            '--epochs', '1', '--batch-size', '8', '--seq-len', '32',
+            '--d-model', '32', '--num-heads', '4', '--num-layers', '2',
+            '--vocab-size', '128', '--limit-steps', '3',
+            '--kfac-factor-update-steps', '1', '--kfac-inv-update-steps', '1',
+        ]
+    )
+    assert ppl > 0
+
+
+def test_lm_example_with_tp_and_sp():
+    from examples import train_language_model
+
+    ppl = train_language_model.main(
+        [
+            '--epochs', '1', '--batch-size', '4', '--seq-len', '32',
+            '--d-model', '32', '--num-heads', '4', '--num-layers', '2',
+            '--vocab-size', '128', '--limit-steps', '2',
+            '--model-shards', '2', '--seq-shards', '2',
+            '--kfac-factor-update-steps', '1', '--kfac-inv-update-steps', '1',
+        ]
+    )
+    assert ppl > 0
+
+
+def test_cifar_example_no_kfac():
+    from examples import train_cifar_resnet
+
+    acc = train_cifar_resnet.main(
+        [
+            '--no-kfac', '--epochs', '1', '--batch-size', '32',
+            '--limit-steps', '2',
+        ]
+    )
+    assert 0.0 <= acc <= 1.0
