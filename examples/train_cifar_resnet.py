@@ -34,6 +34,10 @@ def main(argv=None) -> float:
         '--model', choices=('resnet20', 'resnet32', 'resnet56'),
         default='resnet20',
     )
+    p.add_argument(
+        '--native-loader', action='store_true',
+        help='use the C++ prefetching batch loader (native/loader.cpp)',
+    )
     common.add_train_args(p)
     common.add_kfac_args(p)
     args = p.parse_args(argv)
@@ -82,15 +86,33 @@ def main(argv=None) -> float:
     trainer = training.Trainer(loss_fn=loss_fn, optimizer=optimizer, kfac=kfac)
     state = trainer.init(variables['params'], variables['batch_stats'])
 
+    prefetcher = None
+    if args.native_loader:
+        from kfac_tpu.utils import native_loader
+
+        try:
+            prefetcher = native_loader.PrefetchLoader(
+                x_train, y_train, batch_size=args.batch_size, seed=args.seed
+            )
+        except native_loader.NativeLoaderUnavailable as e:
+            print(f'native loader unavailable ({e}); using python batches')
+
+    def epoch_batches(epoch):
+        if prefetcher is not None:
+            return prefetcher.epoch_batches()
+        return data.batches(
+            x_train, y_train, args.batch_size, args.seed + epoch
+        )
+
     timer = common.Timer()
     test_acc = 0.0
     for epoch in range(args.epochs):
         train_loss = common.Metric()
-        for step, (xb, yb) in enumerate(
-            data.batches(x_train, y_train, args.batch_size, args.seed + epoch)
-        ):
+        for step, (xb, yb) in enumerate(epoch_batches(epoch)):
             if args.limit_steps and step >= args.limit_steps:
-                break
+                # keep consuming so the native loader's epoch stream stays
+                # aligned with ours (it produces full epochs)
+                continue
             batch = (
                 jax.device_put(jnp.asarray(xb), bs),
                 jax.device_put(jnp.asarray(yb), bs),

@@ -132,3 +132,79 @@ class Trainer:
             out = self._jit_no_stats(state, batch)
         self._step_count += 1
         return out
+
+    # --------------------------------------------------------- accumulation
+
+    def _grads_and_stats(self, params, model_state, batch):
+        (loss, new_ms), grads, stats = self._run_stats(
+            params, (model_state, batch)
+        )
+        return loss, new_ms, grads, stats
+
+    def step_accumulate(
+        self, state: TrainState, microbatches
+    ) -> tuple[TrainState, jax.Array]:
+        """One optimization step over several gradient-accumulation
+        micro-batches.
+
+        Gradients and curvature statistics are averaged across micro-batches
+        before the preconditioner step — the reference's mini-step counting
+        (kfac/base_preconditioner.py:126-130,444-455; examples use
+        ``model.no_sync()`` accumulation, examples/vision/engine.py:63-75).
+        Off the factor-update cadence, micro-batches run the no-capture
+        forward (no covariance FLOPs), same as :meth:`step`.
+        """
+        from kfac_tpu.layers import capture as capture_lib
+
+        if self.kfac is None:
+            raise ValueError('step_accumulate requires a kfac preconditioner')
+        if not hasattr(self, '_jit_grads_stats'):
+            self._jit_grads_stats = jax.jit(self._grads_and_stats)
+            self._jit_grads_only = jax.jit(
+                jax.value_and_grad(self.loss_fn, has_aux=True)
+            )
+            self._jit_apply_kfac = jax.jit(
+                self._apply_accumulated, static_argnames=('with_stats',)
+            )
+        capture_now = self._step_count % self.factor_update_steps == 0
+        n = len(microbatches)
+        grads_acc, stats_acc, loss_acc, model_state = None, None, 0.0, state.model_state
+        for mb in microbatches:
+            if capture_now:
+                loss, model_state, grads, stats = self._jit_grads_stats(
+                    state.params, model_state, mb
+                )
+                stats_acc = capture_lib.accumulate_stats(stats_acc, stats)
+            else:
+                (loss, model_state), grads = self._jit_grads_only(
+                    state.params, model_state, mb
+                )
+            loss_acc = loss_acc + loss
+            grads_acc = (
+                grads
+                if grads_acc is None
+                else jax.tree_util.tree_map(jnp_add, grads_acc, grads)
+            )
+        grads_avg = jax.tree_util.tree_map(lambda g: g / n, grads_acc)
+        stats_avg = (
+            capture_lib.average_stats(stats_acc, n) if capture_now else None
+        )
+        new_state = self._jit_apply_kfac(
+            state._replace(model_state=model_state), grads_avg, stats_avg,
+            with_stats=capture_now,
+        )
+        self._step_count += 1
+        return new_state, loss_acc / n
+
+    def _apply_accumulated(self, state: TrainState, grads, stats, with_stats):
+        kfac_state, grads = self.kfac.step(
+            state.kfac_state, grads, stats if with_stats else None
+        )
+        params, opt_state, model_state = self._apply_update(
+            state, grads, state.model_state
+        )
+        return TrainState(params, opt_state, kfac_state, model_state)
+
+
+def jnp_add(a, b):
+    return a + b

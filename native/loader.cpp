@@ -1,0 +1,161 @@
+// Threaded prefetching batch loader for host-side input pipelines.
+//
+// The TPU-native counterpart of the reference's torch DataLoader workers
+// (examples/vision/datasets.py uses torch's C++-backed loader): background
+// threads gather shuffled samples from a (possibly memory-mapped) source
+// array into preallocated batch buffers while the device computes, so host
+// batch assembly overlaps with TPU step time. Exposed as a plain C ABI for
+// ctypes (no pybind11 in this image).
+//
+// Model: the Python side owns the source arrays (data, labels) and a ring
+// of batch output buffers. The loader owns the shuffle order and the worker
+// threads; `loader_next` blocks until the next batch slot is filled and
+// returns its ring index; the consumer calls `loader_release` when the
+// buffer has been handed to the device.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  int64_t ring_index;
+  int64_t epoch;
+};
+
+struct Loader {
+  const float* data;         // (n, sample_elems)
+  const int32_t* labels;     // (n,)
+  int64_t n;
+  int64_t sample_elems;
+  int64_t batch_size;
+  int64_t n_ring;
+  float* batch_data;         // ring: (n_ring, batch_size, sample_elems)
+  int32_t* batch_labels;     // ring: (n_ring, batch_size)
+  uint64_t seed;
+  bool drop_last;
+
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv_ready;
+  std::condition_variable cv_free;
+  std::queue<Batch> ready;
+  std::vector<int64_t> free_slots;
+  std::atomic<bool> stop{false};
+
+  // producer state (single producer thread builds the order, many copy
+  // threads could be added later; one thread suffices for memcpy-bound work)
+  int64_t batches_per_epoch() const {
+    return drop_last ? n / batch_size : (n + batch_size - 1) / batch_size;
+  }
+};
+
+void producer_loop(Loader* L) {
+  std::mt19937_64 rng(L->seed);
+  std::vector<int64_t> order(L->n);
+  for (int64_t i = 0; i < L->n; ++i) order[i] = i;
+  if (L->batches_per_epoch() == 0) return;  // nothing to produce; don't spin
+  int64_t epoch = 0;
+  while (!L->stop.load()) {
+    std::shuffle(order.begin(), order.end(), rng);
+    const int64_t nb = L->batches_per_epoch();
+    for (int64_t b = 0; b < nb && !L->stop.load(); ++b) {
+      int64_t slot;
+      {
+        std::unique_lock<std::mutex> lk(L->mu);
+        L->cv_free.wait(lk, [L] {
+          return L->stop.load() || !L->free_slots.empty();
+        });
+        if (L->stop.load()) return;
+        slot = L->free_slots.back();
+        L->free_slots.pop_back();
+      }
+      float* out = L->batch_data + slot * L->batch_size * L->sample_elems;
+      int32_t* lab = L->batch_labels + slot * L->batch_size;
+      for (int64_t j = 0; j < L->batch_size; ++j) {
+        // wrap for the final ragged batch when drop_last is false
+        int64_t idx = order[(b * L->batch_size + j) % L->n];
+        std::memcpy(out + j * L->sample_elems,
+                    L->data + idx * L->sample_elems,
+                    sizeof(float) * L->sample_elems);
+        lab[j] = L->labels[idx];
+      }
+      {
+        std::lock_guard<std::mutex> lk(L->mu);
+        L->ready.push(Batch{slot, epoch});
+      }
+      L->cv_ready.notify_one();
+    }
+    ++epoch;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* loader_create(const float* data, const int32_t* labels, int64_t n,
+                    int64_t sample_elems, int64_t batch_size, int64_t n_ring,
+                    float* batch_data, int32_t* batch_labels, uint64_t seed,
+                    int drop_last) {
+  auto* L = new Loader();
+  L->data = data;
+  L->labels = labels;
+  L->n = n;
+  L->sample_elems = sample_elems;
+  L->batch_size = batch_size;
+  L->n_ring = n_ring;
+  L->batch_data = batch_data;
+  L->batch_labels = batch_labels;
+  L->seed = seed;
+  L->drop_last = drop_last != 0;
+  for (int64_t s = 0; s < n_ring; ++s) L->free_slots.push_back(s);
+  L->workers.emplace_back(producer_loop, L);
+  return L;
+}
+
+// Blocks until a batch is ready; returns its ring index and writes the
+// epoch it belongs to. Returns -1 if the loader is stopping.
+int64_t loader_next(void* handle, int64_t* epoch_out) {
+  auto* L = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_ready.wait(lk, [L] { return L->stop.load() || !L->ready.empty(); });
+  if (L->ready.empty()) return -1;
+  Batch b = L->ready.front();
+  L->ready.pop();
+  if (epoch_out) *epoch_out = b.epoch;
+  return b.ring_index;
+}
+
+// Marks a ring slot as consumable again.
+void loader_release(void* handle, int64_t ring_index) {
+  auto* L = static_cast<Loader*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->free_slots.push_back(ring_index);
+  }
+  L->cv_free.notify_one();
+}
+
+int64_t loader_batches_per_epoch(void* handle) {
+  return static_cast<Loader*>(handle)->batches_per_epoch();
+}
+
+void loader_destroy(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  L->stop.store(true);
+  L->cv_free.notify_all();
+  L->cv_ready.notify_all();
+  for (auto& t : L->workers) t.join();
+  delete L;
+}
+
+}  // extern "C"

@@ -1,0 +1,37 @@
+"""Triangular Pallas covariance kernel vs dense oracle (interpret mode)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfac_tpu.ops import pallas_cov
+
+
+@pytest.mark.parametrize(
+    'n,d',
+    [(64, 96), (512, 128), (700, 300), (1024, 256)],
+)
+def test_sym_cov_matches_dense(n, d):
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(n, d)).astype(np.float32)
+    got = pallas_cov.sym_cov(jnp.asarray(a), interpret=True)
+    expected = a.T @ a / n
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-4)
+    # exact symmetry by construction
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(got).T)
+
+
+def test_sym_cov_scale_and_dtype():
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(130, 140)).astype(np.float32)
+    got = pallas_cov.sym_cov(jnp.asarray(a, jnp.bfloat16), scale=10.0, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    expected = a.T @ a / 10.0
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), expected, rtol=0.05, atol=0.5
+    )
+
+
+def test_use_pallas_heuristic_cpu_off():
+    # on the CPU test backend the dispatch heuristic must stay off
+    assert not pallas_cov.use_pallas_for(4096)

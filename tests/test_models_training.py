@@ -155,3 +155,43 @@ def test_transformer_training_smoke():
         losses.append(float(l))
     assert losses[-1] < losses[0]
     assert all(np.isfinite(losses))
+
+
+def test_grad_accumulation_matches_full_batch():
+    """Two half-size micro-batches must equal one full-batch step (grads and
+    curvature stats both average exactly for equal-size halves)."""
+    m = MLP(features=(16,), num_classes=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 8))
+    y = jax.nn.one_hot(jnp.arange(32) % 4, 4)
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+
+    def loss_fn(params, model_state, batch):
+        xx, yy = batch
+        logits = m.apply({'params': params}, xx)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * yy, -1)), model_state
+
+    def make_trainer():
+        kfac = kfac_tpu.KFACPreconditioner(registry=reg, damping=0.01, kl_clip=None)
+        return training.Trainer(loss_fn=loss_fn, optimizer=optax.sgd(0.1), kfac=kfac)
+
+    t1 = make_trainer()
+    s1 = t1.init(params)
+    s1, l1 = t1.step(s1, (x, y))
+
+    t2 = make_trainer()
+    s2 = t2.init(params)
+    micro = [(x[:16], y[:16]), (x[16:], y[16:])]
+    s2, l2 = t2.step_accumulate(s2, micro)
+
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(s1.params['dense0']['kernel']),
+        np.asarray(s2.params['dense0']['kernel']),
+        rtol=1e-4, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s1.kfac_state.a['dense0']),
+        np.asarray(s2.kfac_state.a['dense0']),
+        rtol=1e-4, atol=1e-6,
+    )
