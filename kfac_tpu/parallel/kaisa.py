@@ -401,13 +401,13 @@ class DistributedKFAC:
         cfg = self.config
         if stats is not None:
             state = jax.lax.cond(
-                state.step % cfg.factor_update_steps == 0,
+                state.step % _resolve(cfg.factor_update_steps, state.step) == 0,
                 lambda s: self.update_factors(s, stats),
                 lambda s: s,
                 state,
             )
         state = jax.lax.cond(
-            state.step % cfg.inv_update_steps == 0,
+            state.step % _resolve(cfg.inv_update_steps, state.step) == 0,
             self.update_inverses,
             lambda s: s,
             state,

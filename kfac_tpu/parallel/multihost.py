@@ -1,0 +1,80 @@
+"""Multi-host (multi-slice / DCN) initialization and mesh construction.
+
+The reference scales across nodes with torchrun + NCCL/MPI process groups
+(scripts/run_imagenet.sh:35-75, kfac/distributed.py). The JAX equivalent is
+``jax.distributed.initialize`` (one process per host, all devices visible
+as one global world) plus a mesh whose *outer* axes span hosts: collectives
+on inner axes ride ICI, outer axes ride DCN. KAISA's layout maps naturally:
+put the KAISA grid's receiver axis (gradient broadcasts, infrequent) across
+DCN and keep factor/eigh traffic inside a slice.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from kfac_tpu import assignment as assignment_lib
+from kfac_tpu.parallel import mesh as mesh_lib
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up the JAX distributed runtime (no-op if single-process).
+
+    On TPU pods the arguments are auto-detected from the environment; on
+    other platforms pass them explicitly (the torchrun-rendezvous
+    equivalent).
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def hybrid_kaisa_mesh(
+    grad_worker_fraction: float = 1.0,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """KAISA mesh laid out for multi-host topology.
+
+    Devices are ordered host-major, so with the KAISA grid built as
+    (gw, col) = reshape(devices), the *column* (gradient-worker group /
+    second-order state sharing) stays within a host's slice whenever
+    grad_workers <= devices-per-host — inverse traffic rides ICI while only
+    the row-wise gradient broadcast crosses DCN. Single-host it degrades to
+    :func:`kfac_tpu.parallel.mesh.kaisa_mesh`.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    world = len(devices)
+    workers = assignment_lib.grad_worker_count(world, grad_worker_fraction)
+    per_host: dict[int, list[jax.Device]] = {}
+    for d in devices:
+        per_host.setdefault(getattr(d, 'process_index', 0), []).append(d)
+    ordered: list[jax.Device] = []
+    for pid in sorted(per_host):
+        ordered.extend(per_host[pid])
+    # lay columns out as host-contiguous blocks: grid[g, c] = ordered[c*W+g],
+    # so a grad-worker group (fixed c, varying g) is a consecutive device
+    # run within one host whenever workers <= devices-per-host
+    grid = np.asarray(ordered, dtype=object).reshape(
+        world // workers, workers
+    ).T
+    return Mesh(grid, (mesh_lib.GW_AXIS, mesh_lib.COL_AXIS))
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()

@@ -81,6 +81,16 @@ class PipelinedLM:
     dtype: Any = jnp.float32
 
     def __post_init__(self) -> None:
+        import warnings as _warnings
+
+        from kfac_tpu.warnings import ExperimentalFeatureWarning
+
+        _warnings.warn(
+            'pipeline-parallel K-FAC is experimental (the reference flags '
+            'its pipeline support the same way)',
+            ExperimentalFeatureWarning,
+            stacklevel=2,
+        )
         self.n_stages = int(self.mesh.shape[PIPE_AXIS])
         if self.num_layers % self.n_stages != 0:
             raise ValueError('num_layers must divide evenly into stages')
@@ -372,8 +382,8 @@ class PipelineKFAC:
         names = list(self.registry.layers)
         helpers = self.registry.layers
 
-        do_factors = step % cfg.factor_update_steps == 0
-        do_inverses = step % cfg.inv_update_steps == 0
+        do_factors = step % _resolve(cfg.factor_update_steps, step) == 0
+        do_inverses = step % _resolve(cfg.inv_update_steps, step) == 0
 
         def body(a, g, qa, qg, da, dg, sa, sg, stage_grads):
             # everything here is stage-local: leading dim 1, squeezed

@@ -77,8 +77,11 @@ class KFACPreconditioner:
 
     Args:
         registry: output of :func:`kfac_tpu.layers.registry.register_model`.
-        factor_update_steps: steps between factor EMA updates.
-        inv_update_steps: steps between eigendecomposition updates.
+        factor_update_steps: steps between factor EMA updates (int or a
+            schedule of the step counter, the LambdaParamScheduler
+            equivalent — reference kfac/scheduler.py:119-167).
+        inv_update_steps: steps between eigendecomposition updates (int or
+            schedule).
         damping: Tikhonov damping (constant or schedule of step).
         factor_decay: EMA alpha (constant or schedule of step).
         kl_clip: KL clipping bound, or None to disable.
@@ -93,8 +96,8 @@ class KFACPreconditioner:
     """
 
     registry: registry_lib.Registry
-    factor_update_steps: int = 1
-    inv_update_steps: int = 1
+    factor_update_steps: int | Callable[[jax.Array], jax.Array] = 1
+    inv_update_steps: int | Callable[[jax.Array], jax.Array] = 1
     damping: ScalarOrSchedule = 0.001
     factor_decay: ScalarOrSchedule = 0.95
     kl_clip: ScalarOrSchedule | None = 0.001
@@ -113,9 +116,15 @@ class KFACPreconditioner:
                     f'unknown compute_method {self.compute_method!r}; '
                     f'expected one of {[m.name.lower() for m in enums.ComputeMethod]}'
                 ) from None
-        if self.factor_update_steps < 1 or self.inv_update_steps < 1:
-            raise ValueError('update step intervals must be >= 1')
-        if self.inv_update_steps % self.factor_update_steps != 0:
+        for name in ('factor_update_steps', 'inv_update_steps'):
+            value = getattr(self, name)
+            if not callable(value) and value < 1:
+                raise ValueError(f'{name} must be >= 1, got {value}')
+        if (
+            not callable(self.factor_update_steps)
+            and not callable(self.inv_update_steps)
+            and self.inv_update_steps % self.factor_update_steps != 0
+        ):
             warnings.warn(
                 'inv_update_steps is not a multiple of factor_update_steps; '
                 'some inverse updates will recompute from unchanged factors',
@@ -302,13 +311,13 @@ class KFACPreconditioner:
         """
         if stats is not None:
             state = jax.lax.cond(
-                state.step % self.factor_update_steps == 0,
+                state.step % _resolve(self.factor_update_steps, state.step) == 0,
                 lambda s: self.update_factors(s, stats),
                 lambda s: s,
                 state,
             )
         state = jax.lax.cond(
-            state.step % self.inv_update_steps == 0,
+            state.step % _resolve(self.inv_update_steps, state.step) == 0,
             self.update_inverses,
             lambda s: s,
             state,

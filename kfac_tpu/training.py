@@ -67,10 +67,8 @@ class Trainer:
                 return self.loss_fn(params, model_state, batch)
 
             self._run_stats = cap.value_stats_and_grad(wrapped_loss, has_aux=True)
-            if hasattr(self.kfac, 'config'):
-                self.factor_update_steps = self.kfac.config.factor_update_steps
-            else:
-                self.factor_update_steps = self.kfac.factor_update_steps
+            cfg = self.kfac.config if hasattr(self.kfac, 'config') else self.kfac
+            self.factor_update_steps = cfg.factor_update_steps
         self._jit_with_stats = jax.jit(self._step_with_stats)
         self._jit_no_stats = jax.jit(self._step_no_stats)
 
@@ -122,11 +120,17 @@ class Trainer:
 
     # ------------------------------------------------------------- dispatch
 
+    def _capture_now(self) -> bool:
+        """Evaluate the factor cadence host-side (schedules are pure
+        functions of the step, so the host can run them concretely)."""
+        cadence = self.factor_update_steps
+        if callable(cadence):
+            cadence = max(1, int(cadence(self._step_count)))
+        return self._step_count % cadence == 0
+
     def step(self, state: TrainState, batch) -> tuple[TrainState, jax.Array]:
         """One optimization step; picks the capture variant on cadence."""
-        if self.kfac is not None and (
-            self._step_count % self.factor_update_steps == 0
-        ):
+        if self.kfac is not None and self._capture_now():
             out = self._jit_with_stats(state, batch)
         else:
             out = self._jit_no_stats(state, batch)
@@ -166,7 +170,7 @@ class Trainer:
             self._jit_apply_kfac = jax.jit(
                 self._apply_accumulated, static_argnames=('with_stats',)
             )
-        capture_now = self._step_count % self.factor_update_steps == 0
+        capture_now = self._capture_now()
         n = len(microbatches)
         grads_acc, stats_acc, loss_acc, model_state = None, None, 0.0, state.model_state
         for mb in microbatches:

@@ -1,0 +1,7 @@
+"""Warning categories (reference parity: kfac/warnings.py:6-9)."""
+
+from __future__ import annotations
+
+
+class ExperimentalFeatureWarning(Warning):
+    """Feature is experimental and may change or underperform."""

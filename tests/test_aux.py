@@ -169,3 +169,73 @@ def test_checkpoint_roundtrip_distributed(tmp_path):
         np.asarray(p1['fc1']['kernel']), np.asarray(p2['fc1']['kernel']),
         rtol=1e-4, atol=1e-6,
     )
+
+
+def test_scheduled_cadence():
+    """factor/inv update cadence can itself be a schedule of the step
+    (reference LambdaParamScheduler scales the update intervals)."""
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    # update factors every step for the first 2 steps, then every 4
+    cadence = lambda step: jnp.where(step < 2, 1, 4)
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg, factor_update_steps=cadence, inv_update_steps=cadence,
+        kl_clip=None,
+    )
+    cap = kfac_tpu.CurvatureCapture(reg)
+    run = cap.value_stats_and_grad(models.mse_loss(m))
+    state = kfac.init()
+    import jax as _jax
+    step_fn = _jax.jit(kfac.step)
+    a_hist = []
+    for i in range(6):
+        (_, _), grads, stats = run(params, (x, y))
+        state, _ = step_fn(state, grads, stats)
+        a_hist.append(np.asarray(state.a['fc1']).copy())
+    # steps 0,1 update; steps 2,3 hold (2%4!=0, 3%4!=0); step 4 updates
+    assert np.abs(a_hist[1] - a_hist[0]).max() > 0
+    np.testing.assert_array_equal(a_hist[2], a_hist[1])
+    np.testing.assert_array_equal(a_hist[3], a_hist[2])
+    assert np.abs(a_hist[4] - a_hist[3]).max() > 0
+
+
+def test_multihost_helpers_single_process():
+    from kfac_tpu.parallel import multihost
+
+    assert multihost.process_count() == 1
+    assert multihost.process_index() == 0
+    multihost.initialize(num_processes=1)  # no-op path
+    mesh = multihost.hybrid_kaisa_mesh(grad_worker_fraction=0.5)
+    assert mesh.shape['kfac_gw'] == 4 and mesh.shape['kfac_col'] == 2
+
+
+def test_experimental_warning_importable():
+    from kfac_tpu.warnings import ExperimentalFeatureWarning
+
+    assert issubclass(ExperimentalFeatureWarning, Warning)
+
+
+def test_mixed_cadence_validation():
+    """An invalid int interval is rejected even when the other is a schedule."""
+    m = models.TinyModel()
+    x, _ = models.regression_data(jax.random.PRNGKey(1))
+    reg = kfac_tpu.register_model(m, x)
+    with pytest.raises(ValueError):
+        kfac_tpu.KFACPreconditioner(
+            registry=reg,
+            factor_update_steps=lambda s: 1,
+            inv_update_steps=0,
+        )
+
+
+def test_hybrid_mesh_columns_are_contiguous_blocks():
+    from kfac_tpu.parallel import multihost
+
+    mesh = multihost.hybrid_kaisa_mesh(grad_worker_fraction=0.5)
+    # columns (grad-worker groups) must be consecutive device runs
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    for c in range(ids.shape[1]):
+        col = ids[:, c]
+        assert list(col) == list(range(col[0], col[0] + len(col)))
