@@ -84,6 +84,7 @@ class DistKFACState(NamedTuple):
     qg: dict[str, jax.Array]
     da: dict[str, jax.Array]
     dg: dict[str, jax.Array]
+    dgda: dict[str, jax.Array]
     a_inv: dict[str, jax.Array]
     g_inv: dict[str, jax.Array]
 
@@ -123,12 +124,7 @@ class DistributedKFAC:
             grad_worker_fraction=self.grad_workers / self.world,
         )
         self._eigen = self.config.compute_method == enums.ComputeMethod.EIGEN
-        if self.config.prediv_eigenvalues:
-            raise NotImplementedError(
-                'prediv_eigenvalues is not supported by the stacked '
-                'distributed engine yet; use the dense KFACPreconditioner '
-                'or disable it'
-            )
+        self._prediv = self._eigen and self.config.prediv_eigenvalues
 
     # ------------------------------------------------------------ shardings
 
@@ -160,8 +156,9 @@ class DistributedKFAC:
             g=bdict(fac),
             qa=bdict(dec) if eigen else {},
             qg=bdict(dec) if eigen else {},
-            da=bdict(dec) if eigen else {},
-            dg=bdict(dec) if eigen else {},
+            da=bdict(dec) if eigen and not self._prediv else {},
+            dg=bdict(dec) if eigen and not self._prediv else {},
+            dgda=bdict(dec) if self._prediv else {},
             a_inv={} if eigen else bdict(dec),
             g_inv={} if eigen else bdict(dec),
         )
@@ -173,7 +170,7 @@ class DistributedKFAC:
 
         def build() -> DistKFACState:
             cfg = self.config
-            a, g, qa, qg, da, dg, a_inv, g_inv = ({} for _ in range(8))
+            a, g, qa, qg, da, dg, dgda, a_inv, g_inv = ({} for _ in range(9))
             for b in self.buckets:
                 eye_a = jnp.broadcast_to(
                     jnp.eye(b.da, dtype=cfg.factor_dtype), (b.padded, b.da, b.da)
@@ -186,14 +183,19 @@ class DistributedKFAC:
                 if self._eigen:
                     qa[b.key] = jnp.zeros((b.padded, b.da, b.da), cfg.inv_dtype)
                     qg[b.key] = jnp.zeros((b.padded, b.dg, b.dg), cfg.inv_dtype)
-                    da[b.key] = jnp.zeros((b.padded, b.da), cfg.inv_dtype)
-                    dg[b.key] = jnp.zeros((b.padded, b.dg), cfg.inv_dtype)
+                    if self._prediv:
+                        dgda[b.key] = jnp.zeros(
+                            (b.padded, b.dg, b.da), cfg.inv_dtype
+                        )
+                    else:
+                        da[b.key] = jnp.zeros((b.padded, b.da), cfg.inv_dtype)
+                        dg[b.key] = jnp.zeros((b.padded, b.dg), cfg.inv_dtype)
                 else:
                     a_inv[b.key] = jnp.zeros((b.padded, b.da, b.da), cfg.inv_dtype)
                     g_inv[b.key] = jnp.zeros((b.padded, b.dg, b.dg), cfg.inv_dtype)
             return DistKFACState(
                 step=jnp.asarray(0, jnp.int32),
-                a=a, g=g, qa=qa, qg=qg, da=da, dg=dg,
+                a=a, g=g, qa=qa, qg=qg, da=da, dg=dg, dgda=dgda,
                 a_inv=a_inv, g_inv=g_inv,
             )
 
@@ -298,7 +300,7 @@ class DistributedKFAC:
         damping = _resolve(cfg.damping, state.step)
         dec = NamedSharding(self.mesh, self._decomp_spec())
         if self._eigen:
-            qa, qg, da, dg = {}, {}, {}, {}
+            qa, qg, da, dg, dgda = {}, {}, {}, {}, {}
             for b in self.buckets:
                 q_a, d_a = self._sharded_eigh(state.a[b.key])
                 q_g, d_g = self._sharded_eigh(state.g[b.key])
@@ -307,9 +309,21 @@ class DistributedKFAC:
                 # world for COMM-OPT) here.
                 qa[b.key] = jax.lax.with_sharding_constraint(q_a.astype(cfg.inv_dtype), dec)
                 qg[b.key] = jax.lax.with_sharding_constraint(q_g.astype(cfg.inv_dtype), dec)
-                da[b.key] = jax.lax.with_sharding_constraint(d_a.astype(cfg.inv_dtype), dec)
-                dg[b.key] = jax.lax.with_sharding_constraint(d_g.astype(cfg.inv_dtype), dec)
-            return state._replace(qa=qa, qg=qg, da=da, dg=dg)
+                if self._prediv:
+                    fused = jax.vmap(
+                        lambda da_, dg_: factors_lib.prediv_eigenvalues(
+                            factors_lib.EigenDecomp(q=None, d=da_),
+                            factors_lib.EigenDecomp(q=None, d=dg_),
+                            damping,
+                        )
+                    )(d_a, d_g)
+                    dgda[b.key] = jax.lax.with_sharding_constraint(
+                        fused.astype(cfg.inv_dtype), dec
+                    )
+                else:
+                    da[b.key] = jax.lax.with_sharding_constraint(d_a.astype(cfg.inv_dtype), dec)
+                    dg[b.key] = jax.lax.with_sharding_constraint(d_g.astype(cfg.inv_dtype), dec)
+            return state._replace(qa=qa, qg=qg, da=da, dg=dg, dgda=dgda)
         a_inv, g_inv = {}, {}
         for b in self.buckets:
             a_inv[b.key] = jax.lax.with_sharding_constraint(
@@ -349,7 +363,16 @@ class DistributedKFAC:
                 rows += [jnp.zeros((b.dg, b.da), rows[0].dtype)] * pad
             gstack = jnp.stack(rows).astype(cfg.inv_dtype)
             gstack = jax.lax.with_sharding_constraint(gstack, dec)
-            if self._eigen:
+            if self._prediv:
+                def prec_fused(gm, qa_, qg_, fused_):
+                    v1 = qg_.T @ gm @ qa_
+                    return qg_ @ (v1 * fused_) @ qa_.T
+
+                pstack = jax.vmap(prec_fused)(
+                    gstack, state.qa[b.key], state.qg[b.key],
+                    state.dgda[b.key],
+                )
+            elif self._eigen:
                 qa, qg = state.qa[b.key], state.qg[b.key]
                 dada, dgdg = state.da[b.key], state.dg[b.key]
 
@@ -438,7 +461,7 @@ class DistributedKFAC:
             'a_inverses': nbytes(state.qa, shard_d) + nbytes(state.da, shard_d)
             + nbytes(state.a_inv, shard_d),
             'g_inverses': nbytes(state.qg, shard_d) + nbytes(state.dg, shard_d)
-            + nbytes(state.g_inv, shard_d),
+            + nbytes(state.dgda, shard_d) + nbytes(state.g_inv, shard_d),
         }
         sizes['total'] = sum(sizes.values())
         return sizes

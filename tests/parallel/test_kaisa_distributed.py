@@ -201,3 +201,49 @@ def test_unexecuted_layer_keeps_factors():
         if 'fc1' in b.layers:
             i = b.layers.index('fc1')
             assert np.abs(np.asarray(state2.a[b.key][i]) - np.eye(b.da)).max() > 0
+
+
+def test_prediv_eigenvalues_distributed_matches_plain():
+    """prediv fuses 1/(dg x da + damping) at inverse time; results must
+    match the on-the-fly division path."""
+    mesh = kaisa_mesh(grad_worker_fraction=0.5)
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=64, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    loss_fn = models.mse_loss(m)
+    cap = kfac_tpu.CurvatureCapture(reg)
+    (_, _), grads, stats = cap.value_stats_and_grad(loss_fn)(params, (x, y))
+
+    outs = {}
+    for prediv in (False, True):
+        cfg = kfac_tpu.KFACPreconditioner(
+            registry=reg, damping=0.01, kl_clip=None,
+            prediv_eigenvalues=prediv,
+        )
+        dk = DistributedKFAC(config=cfg, mesh=mesh)
+        state = dk.init()
+        if prediv:
+            assert state.dgda and not state.da
+        state, pg = jax.jit(dk.step)(state, grads, stats)
+        outs[prediv] = pg
+    np.testing.assert_allclose(
+        np.asarray(outs[True]['fc1']['kernel']),
+        np.asarray(outs[False]['fc1']['kernel']),
+        rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_prediv_memory_accounted():
+    mesh = kaisa_mesh(grad_worker_fraction=0.5)
+    m = models.TinyModel()
+    x, _ = models.regression_data(jax.random.PRNGKey(1), n=64, dim=6)
+    reg = kfac_tpu.register_model(m, x)
+    cfg = kfac_tpu.KFACPreconditioner(registry=reg, prediv_eigenvalues=True)
+    dk = DistributedKFAC(config=cfg, mesh=mesh)
+    usage = dk.memory_usage(dk.init())
+    # the fused dgda buffer must be counted (it replaces da/dg)
+    expected_dgda = sum(
+        b.padded * b.dg * b.da * 4 for b in dk.buckets
+    ) / mesh_lib.n_cols(mesh)
+    assert usage['g_inverses'] >= expected_dgda
