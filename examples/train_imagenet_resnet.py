@@ -74,7 +74,9 @@ def main(argv=None) -> float:
             updates['batch_stats'],
         )
 
-    trainer = training.Trainer(loss_fn=loss_fn, optimizer=optimizer, kfac=kfac)
+    trainer = training.Trainer(
+        loss_fn=loss_fn, optimizer=optimizer, kfac=kfac, donate_state=True
+    )
     state = trainer.init(variables['params'], variables['batch_stats'])
 
     acc_val = 0.0

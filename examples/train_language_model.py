@@ -86,7 +86,9 @@ def main(argv=None) -> float:
         optax.clip_by_global_norm(1.0),  # grad-norm clip before precondition
         optax.sgd(lr_sched, momentum=args.momentum),
     )
-    trainer = training.Trainer(loss_fn=loss_fn, optimizer=optimizer, kfac=kfac)
+    trainer = training.Trainer(
+        loss_fn=loss_fn, optimizer=optimizer, kfac=kfac, donate_state=True
+    )
     state = trainer.init(params)
 
     ts = token_sharding(mesh)

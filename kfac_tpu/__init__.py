@@ -8,7 +8,7 @@ checkpointing — built on pjit/shard_map collectives instead of
 torch.distributed.
 """
 
-from kfac_tpu import enums
+from kfac_tpu import checkpoint, enums, hyperparams, tracing
 from kfac_tpu.enums import (
     AllreduceMethod,
     AssignmentStrategy,
@@ -18,6 +18,7 @@ from kfac_tpu.enums import (
 from kfac_tpu.layers.capture import CapturedStats, CurvatureCapture
 from kfac_tpu.layers.registry import Registry, register_model
 from kfac_tpu.preconditioner import KFACPreconditioner, KFACState
+from kfac_tpu.training import Trainer, TrainState
 
 __version__ = '0.1.0'
 
@@ -31,6 +32,11 @@ __all__ = [
     'KFACPreconditioner',
     'KFACState',
     'Registry',
+    'TrainState',
+    'Trainer',
+    'checkpoint',
     'enums',
+    'hyperparams',
     'register_model',
+    'tracing',
 ]

@@ -125,6 +125,14 @@ class DistributedKFAC:
         )
         self._eigen = self.config.compute_method == enums.ComputeMethod.EIGEN
         self._prediv = self._eigen and self.config.prediv_eigenvalues
+        if self.config.prediv_eigenvalues and not self._eigen:
+            import warnings as _warnings
+
+            _warnings.warn(
+                'prediv_eigenvalues has no effect with the INVERSE compute '
+                'method; ignoring',
+                stacklevel=2,
+            )
 
     # ------------------------------------------------------------ shardings
 

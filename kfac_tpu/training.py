@@ -40,6 +40,11 @@ class Trainer:
         loss_fn: ``loss_fn(params, model_state, batch) -> (loss,
             new_model_state)``; ``model_state`` may be None for stateless
             models. Must call the flax model inside so capture can intercept.
+        donate_state: donate the TrainState buffers to each step (halves
+            peak memory for params/opt/K-FAC state). Off by default because
+            donation also invalidates the arrays the state was built from
+            (e.g. the params passed to ``init``); enable for production
+            training loops that never touch stale state.
         kfac: a :class:`kfac_tpu.KFACPreconditioner` or
             :class:`kfac_tpu.parallel.DistributedKFAC` (or None for a
             first-order baseline).
@@ -52,6 +57,7 @@ class Trainer:
     kfac: Any = None
     registry: Any = None
     factor_update_steps: int = 1
+    donate_state: bool = False
 
     def __post_init__(self) -> None:
         self._step_count = 0
@@ -69,8 +75,9 @@ class Trainer:
             self._run_stats = cap.value_stats_and_grad(wrapped_loss, has_aux=True)
             cfg = self.kfac.config if hasattr(self.kfac, 'config') else self.kfac
             self.factor_update_steps = cfg.factor_update_steps
-        self._jit_with_stats = jax.jit(self._step_with_stats)
-        self._jit_no_stats = jax.jit(self._step_no_stats)
+        donate = (0,) if self.donate_state else ()
+        self._jit_with_stats = jax.jit(self._step_with_stats, donate_argnums=donate)
+        self._jit_no_stats = jax.jit(self._step_no_stats, donate_argnums=donate)
 
     # ------------------------------------------------------------- builders
 
