@@ -64,11 +64,10 @@ def _probe_backend(timeouts=(90.0, 30.0)):
     it exists only to catch a claim released moments ago. Returns
     (platform, device_kind) or None if no healthy non-CPU backend appeared.
     """
-    if not os.environ.get('PALLAS_AXON_POOL_IPS') or (
-        os.environ.get('JAX_PLATFORMS') == 'cpu'
-    ):
-        # No TPU plugin will register / platform is pinned to host — skip
-        # the sacrificial child entirely.
+    if os.environ.get('JAX_PLATFORMS') == 'cpu':
+        # Platform explicitly pinned to host (CI / CPU smoke) — skip the
+        # sacrificial child. An absent axon tunnel does NOT skip: a normal
+        # accelerator backend (e.g. libtpu) should still be detected.
         return None
     code = (
         'import jax; d = jax.devices()[0]; '

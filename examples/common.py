@@ -120,8 +120,14 @@ def accuracy(logits, labels) -> float:
     return float((jnp.argmax(logits, -1) == labels).mean())
 
 
-def build_kfac(args, registry, mesh=None):
-    """Construct the (distributed) preconditioner from CLI flags."""
+def build_kfac(args, registry, mesh=None, lr=None):
+    """Construct the (distributed) preconditioner from CLI flags.
+
+    ``lr`` should be the live optimizer schedule so the KL-clip scale
+    ``min(1, sqrt(kl_clip/|vg*lr^2|))`` tracks warmup/decay the way the
+    reference reads the optimizer's current lr (kfac/preconditioner.py
+    lr-callable); falls back to the constant base lr.
+    """
     if not args.kfac:
         return None
     cfg = kfac_tpu.KFACPreconditioner(
@@ -131,7 +137,7 @@ def build_kfac(args, registry, mesh=None):
         damping=args.kfac_damping,
         factor_decay=args.kfac_factor_decay,
         kl_clip=args.kfac_kl_clip,
-        lr=args.lr,
+        lr=args.lr if lr is None else lr,
         compute_method=args.kfac_compute_method,
     )
     if mesh is not None:

@@ -66,7 +66,7 @@ def main(argv=None) -> float:
     lr_sched = common.make_lr_schedule(
         args.lr, steps_per_epoch, args.epochs, args.warmup_epochs, args.lr_decay
     )
-    kfac = common.build_kfac(args, registry, mesh=mesh)
+    kfac = common.build_kfac(args, registry, mesh=mesh, lr=lr_sched)
     optimizer = optax.chain(
         optax.add_decayed_weights(args.weight_decay),
         optax.sgd(lr_sched, momentum=args.momentum),
@@ -112,9 +112,7 @@ def main(argv=None) -> float:
         train_loss = common.Metric()
         for step, (xb, yb) in enumerate(epoch_batches(epoch)):
             if args.limit_steps and step >= args.limit_steps:
-                # keep consuming so the native loader's epoch stream stays
-                # aligned with ours (it produces full epochs)
-                continue
+                break
             batch = (
                 jax.device_put(jnp.asarray(xb), bs),
                 jax.device_put(jnp.asarray(yb), bs),

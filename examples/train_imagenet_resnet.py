@@ -57,7 +57,7 @@ def main(argv=None) -> float:
     lr_sched = common.make_lr_schedule(
         args.lr, steps_per_epoch, args.epochs, args.warmup_epochs, args.lr_decay
     )
-    kfac = common.build_kfac(args, registry, mesh=mesh)
+    kfac = common.build_kfac(args, registry, mesh=mesh, lr=lr_sched)
     optimizer = optax.chain(
         optax.add_decayed_weights(args.weight_decay),
         optax.sgd(lr_sched, momentum=args.momentum),

@@ -81,7 +81,7 @@ def main(argv=None) -> float:
     lr_sched = common.make_lr_schedule(
         args.lr, steps_per_epoch, args.epochs, args.warmup_epochs, args.lr_decay
     )
-    kfac = common.build_kfac(args, registry, mesh=mesh)
+    kfac = common.build_kfac(args, registry, mesh=mesh, lr=lr_sched)
     optimizer = optax.chain(
         optax.clip_by_global_norm(1.0),  # grad-norm clip before precondition
         optax.sgd(lr_sched, momentum=args.momentum),

@@ -102,3 +102,16 @@ def n_cols(mesh: Mesh) -> int:
 
 def grad_workers(mesh: Mesh) -> int:
     return mesh.shape[GW_AXIS]
+
+
+def device_at(mesh: Mesh, index: int) -> jax.Device:
+    """Physical device for a logical KAISA device index.
+
+    KAISAAssignment queries (src_grad_worker, grad_worker_group, ...) speak
+    in *logical* indices: device d sits at mesh grid coordinates
+    (row, col) = divmod(d, n_cols), i.e. row-major over ``mesh.devices``.
+    For :func:`kaisa_mesh` that equals the jax.devices() order; for
+    permuted layouts (e.g. multihost.hybrid_kaisa_mesh) it does not — use
+    this helper to resolve the physical device.
+    """
+    return np.asarray(mesh.devices).flat[index]

@@ -53,6 +53,12 @@ def hybrid_kaisa_mesh(
     grad_workers <= devices-per-host — inverse traffic rides ICI while only
     the row-wise gradient broadcast crosses DCN. Single-host it degrades to
     :func:`kfac_tpu.parallel.mesh.kaisa_mesh`.
+
+    Note on device numbering: this grid is a *permutation* of the input
+    device order (host-contiguous columns), so KAISAAssignment's device
+    indices are logical mesh coordinates here, not jax.devices() positions;
+    resolve them with :func:`kfac_tpu.parallel.mesh.device_at`. Execution is
+    unaffected (all layouts are mesh-relative).
     """
     devices = list(devices if devices is not None else jax.devices())
     world = len(devices)

@@ -60,7 +60,13 @@ class Trainer:
     donate_state: bool = False
 
     def __post_init__(self) -> None:
-        self._step_count = 0
+        # Host-side mirror of kfac_state.step, used only for cadence
+        # dispatch. None = not yet synced: the first step()/step_accumulate()
+        # reads the device counter, so a Trainer driving a state restored by
+        # ``checkpoint.restore`` at step N stays aligned with the device-side
+        # lax.cond cadence instead of silently freezing factor updates
+        # (host picks no-stats variant while device cond expects stats).
+        self._step_count: int | None = None
         if self.kfac is not None:
             if self.registry is None:
                 self.registry = self.kfac.config.registry if hasattr(
@@ -127,6 +133,21 @@ class Trainer:
 
     # ------------------------------------------------------------- dispatch
 
+    def resume(self, state: TrainState) -> None:
+        """Align cadence dispatch with a (restored) TrainState's step.
+
+        Called automatically on the first ``step``; call explicitly after
+        swapping in a different state mid-run.
+        """
+        ks = state.kfac_state
+        self._step_count = (
+            0 if ks is None else int(jax.device_get(ks.step))
+        )
+
+    def _sync_step_count(self, state: TrainState) -> None:
+        if self._step_count is None:
+            self.resume(state)
+
     def _capture_now(self) -> bool:
         """Evaluate the factor cadence host-side (schedules are pure
         functions of the step, so the host can run them concretely)."""
@@ -137,6 +158,7 @@ class Trainer:
 
     def step(self, state: TrainState, batch) -> tuple[TrainState, jax.Array]:
         """One optimization step; picks the capture variant on cadence."""
+        self._sync_step_count(state)
         if self.kfac is not None and self._capture_now():
             out = self._jit_with_stats(state, batch)
         else:
@@ -169,6 +191,7 @@ class Trainer:
 
         if self.kfac is None:
             raise ValueError('step_accumulate requires a kfac preconditioner')
+        self._sync_step_count(state)
         if not hasattr(self, '_jit_grads_stats'):
             self._jit_grads_stats = jax.jit(self._grads_and_stats)
             self._jit_grads_only = jax.jit(

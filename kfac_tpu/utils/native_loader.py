@@ -114,23 +114,37 @@ class PrefetchLoader:
             seed, int(drop_last),
         )
         self.batches_per_epoch = int(lib.loader_batches_per_epoch(self._handle))
+        self._next_epoch = 0  # epoch the next epoch_batches() call serves
 
     def __iter__(self):
         return self.epoch_batches()
 
     def epoch_batches(self):
-        """Yield one epoch of (data, labels) batches (copies — safe to hold)."""
-        for _ in range(self.batches_per_epoch):
+        """Yield one epoch of (data, labels) batches (copies — safe to hold).
+
+        The producer free-runs across epochs; if a previous consumer stopped
+        early (break/exception), slots from the unfinished epoch are drained
+        here using the producer's epoch counter, so every call starts at a
+        fresh epoch boundary — no keep-consuming contract on the caller.
+        """
+        target = self._next_epoch
+        self._next_epoch = target + 1
+        yielded = 0
+        while yielded < self.batches_per_epoch:
             epoch = ctypes.c_int64()
             slot = self._lib.loader_next(self._handle, ctypes.byref(epoch))
             if slot < 0:
                 return
+            if epoch.value < target:  # leftover from an abandoned epoch
+                self._lib.loader_release(self._handle, slot)
+                continue
             x = self._ring_data[slot].reshape(
                 (self.batch_size,) + self.sample_shape
             ).copy()
             y = self._ring_labels[slot].copy()
             self._lib.loader_release(self._handle, slot)
             yield x, y
+            yielded += 1
 
     def close(self) -> None:
         if self._handle is not None:

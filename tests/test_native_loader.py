@@ -71,3 +71,21 @@ def test_zero_batches_raises(loader_cls):
     labels = np.zeros(5, dtype=np.int32)
     with pytest.raises(ValueError):
         loader_cls(data, labels, batch_size=10)
+
+
+def test_early_break_resyncs_next_epoch(loader_cls):
+    """A consumer abandoning an epoch mid-stream must not leak its leftover
+    batches into the next epoch_batches() call (stale slots are drained
+    using the producer's epoch counter)."""
+    n, bs = 64, 8
+    data = np.zeros((n, 2), dtype=np.float32)
+    labels = np.arange(n, dtype=np.int32)
+    ldr = loader_cls(data, labels, batch_size=bs, n_ring=3, seed=3)
+    for i, (_, y) in enumerate(ldr.epoch_batches()):
+        if i == 2:
+            break  # abandon epoch 0 after 3 of 8 batches
+    e1 = [y for _, y in ldr.epoch_batches()]
+    # the next call serves one *complete* fresh epoch
+    assert len(e1) == ldr.batches_per_epoch
+    assert sorted(np.concatenate(e1).tolist()) == list(range(n))
+    ldr.close()
