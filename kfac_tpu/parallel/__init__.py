@@ -5,6 +5,7 @@ from kfac_tpu.parallel.kaisa import DistKFACState, DistributedKFAC, build_bucket
 from kfac_tpu.parallel.mesh import (
     batch_sharding,
     kaisa_mesh,
+    pipeline_mesh,
     replicated,
     token_sharding,
     train_mesh,
@@ -22,6 +23,7 @@ __all__ = [
     'kaisa_mesh',
     'mesh',
     'pipeline',
+    'pipeline_mesh',
     'replicated',
     'tensor_parallel',
     'token_sharding',

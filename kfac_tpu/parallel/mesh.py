@@ -25,6 +25,7 @@ COL_AXIS = 'kfac_col'
 DATA_AXES = (GW_AXIS, COL_AXIS)
 MODEL_AXIS = 'model'
 SEQ_AXIS = 'seq'
+PIPE_AXIS = 'pipe'
 
 
 def kaisa_mesh(
@@ -73,6 +74,37 @@ def train_mesh(
         workers, dp // workers, model, seq
     )
     return Mesh(grid, (GW_AXIS, COL_AXIS, MODEL_AXIS, SEQ_AXIS))
+
+
+def pipeline_mesh(
+    n_stages: int,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ('pipe', 'kfac_gw', 'kfac_col') mesh: PP composed with DP.
+
+    The reference composes its pipeline with data parallelism through the
+    DeepSpeed topology and reduces factors over the DP group
+    (kfac/gpt_neox/preconditioner.py:70-73, gpt_neox/layer.py:61-93). Here
+    the composition is one mesh: stages shard over the leading ``pipe``
+    axis; the batch and factor statistics shard/reduce over the KAISA data
+    axes. ``pipe`` is outermost so DP collectives (gradient and stat psum)
+    stay within a stage's device block.
+
+    There is no grad-worker-fraction knob: pipeline K-FAC hardwires the
+    reference's MEM-OPT-among-pipe-peers placement (second-order work is
+    stage-local, kfac/gpt_neox/assignment.py:95-130), so the KAISA grid
+    shape would have no effect. The data axes are kept as
+    (kfac_gw=1, kfac_col=dp) so batch/token sharding helpers apply
+    unchanged. Distributing each stage's eigh work across its DP peers is
+    a possible future optimization.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    world = len(devices)
+    if world % n_stages != 0:
+        raise ValueError(f'{world} devices not divisible by {n_stages} stages')
+    dp = world // n_stages
+    grid = np.asarray(devices, dtype=object).reshape(n_stages, 1, dp)
+    return Mesh(grid, (PIPE_AXIS, GW_AXIS, COL_AXIS))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
