@@ -35,6 +35,11 @@ def get_cov(
     The self-covariance is symmetrized ``(C + C^T)/2`` to guard against
     floating-point asymmetry before eigh. Reference:
     kfac/layers/utils.py:18-59.
+
+    On TPU, self-covariances with factor dims spanning ≥ 2 MXU tiles
+    dispatch to the triangular Pallas kernel (exactly symmetric by
+    construction, half the MXU FLOPs): via its GSPMD partitioning rule
+    under jit, or directly on the local rows inside ``shard_map``.
     """
     if a.ndim != 2:
         raise ValueError(f'expected 2D tensor, got shape {a.shape}')
@@ -43,6 +48,27 @@ def get_cov(
     if scale is None:
         scale = a.shape[0]
     if b is None:
+        from kfac_tpu.ops import pallas_cov
+
+        if pallas_cov.use_pallas_for(a.shape[1]):
+            # A shard_map body (even one manual over a subset of mesh axes)
+            # must run the raw local kernel: custom_partitioning cannot
+            # trace inside a manual region. Detect via the mesh's axis
+            # types AND the input's varying-manual-axes set (covers
+            # check_vma=False partial shard_maps too).
+            am = jax.sharding.get_abstract_mesh()
+            manual = (
+                any('manual' in str(t).lower()
+                    for t in getattr(am, 'axis_types', ()))
+                or bool(getattr(jax.typeof(a), 'vma', ()))
+            )
+            if manual:  # shard_map body: rows are already device-local
+                c = pallas_cov.sym_cov(
+                    a, scale=1.0, interpret=pallas_cov.interpret_mode()
+                )
+            else:
+                c = pallas_cov.sym_cov_spmd(a)
+            return c / scale
         cov = a.T @ (a / scale)
         return (cov + cov.T) / 2.0
     return a.T @ (b / scale)

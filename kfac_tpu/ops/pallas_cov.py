@@ -9,12 +9,16 @@ afterwards. Numerically the result is exactly symmetric, so the reference's
 defensive ``(C + C^T)/2`` symmetrization (kfac/layers/utils.py:18-59)
 becomes a no-op by construction.
 
-Status: validated against the dense oracle in interpret mode; **not wired
-into the default ``get_cov`` dispatch** because under GSPMD the activation
-rows are batch-sharded and an un-annotated ``pallas_call`` would force a
-gather (or fail to partition). Use it explicitly for unsharded/owned data,
-or wrap in ``shard_map`` with a local-rows + psum pattern; auto-dispatch is
-planned once it can be profiled on real multi-chip TPU.
+GSPMD integration: batch-sharded activation rows cannot flow into a plain
+``pallas_call`` (XLA cannot partition an opaque custom call — it would force
+a gather). :func:`sym_cov_spmd` wraps the kernel in
+``jax.experimental.custom_partitioning`` with the local-rows + psum rule:
+each device runs the triangular kernel on its row shard and the partial
+covariances all-reduce over the row-sharding axes — the same schedule GSPMD
+derives for a plain ``a^T a`` contraction, minus the redundant lower
+triangle. ``ops.cov.get_cov`` dispatches here on TPU for factor dims
+spanning ≥ 2 MXU tiles (:func:`use_pallas_for`); inside ``shard_map``
+(manual axes) the raw kernel runs directly on the local rows.
 """
 
 from __future__ import annotations
@@ -24,6 +28,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 TILE = 128       # lane-aligned C-block edge
 K_BLOCK = 512    # rows of `a` consumed per reduction step
@@ -69,9 +75,17 @@ def sym_cov(a: jax.Array, scale=None, interpret: bool = False) -> jax.Array:
     nblk = d_pad // TILE
     nk = n_pad // K_BLOCK
 
+    # inside a vma-checked shard_map the output varies over the same mesh
+    # axes as the (device-local) input rows
+    vma = getattr(jax.typeof(ap), 'vma', None)
+    out_shape = (
+        jax.ShapeDtypeStruct((d_pad, d_pad), jnp.float32, vma=vma)
+        if vma is not None
+        else jax.ShapeDtypeStruct((d_pad, d_pad), jnp.float32)
+    )
     upper = pl.pallas_call(
         _sym_cov_kernel,
-        out_shape=jax.ShapeDtypeStruct((d_pad, d_pad), jnp.float32),
+        out_shape=out_shape,
         grid=(nblk, nblk, nk),
         in_specs=[
             pl.BlockSpec((K_BLOCK, TILE), lambda i, j, k: (k, i)),
@@ -87,6 +101,51 @@ def sym_cov(a: jax.Array, scale=None, interpret: bool = False) -> jax.Array:
     full = jnp.where(cols >= rows, upper, upper.T)
     cov = full[:d, :d] / scale
     return cov.astype(out_dtype)
+
+
+def interpret_mode() -> bool:
+    """Run the kernel in interpret mode off-TPU (tests, CPU meshes)."""
+    return jax.default_backend() != 'tpu'
+
+
+@custom_partitioning
+def sym_cov_spmd(a: jax.Array) -> jax.Array:
+    """Unscaled symmetric second moment ``a^T @ a`` that partitions under
+    GSPMD: row-sharded inputs compute local triangular covariances that
+    psum over the row axes (the schedule the reference gets from NCCL
+    factor allreduce, kfac/layers/base.py:282-336, expressed as a
+    partitioning rule instead of an explicit collective)."""
+    return sym_cov(a, scale=1.0, interpret=interpret_mode())
+
+
+def _spmd_infer(mesh, arg_shapes, result_shape):
+    del arg_shapes, result_shape
+    return NamedSharding(mesh, P())
+
+
+def _spmd_partition(mesh, arg_shapes, result_shape):
+    del result_shape
+    row_axes = arg_shapes[0].sharding.spec[0]
+
+    def lower(a):
+        c = sym_cov(a, scale=1.0, interpret=interpret_mode())
+        if row_axes is not None:
+            c = jax.lax.psum(c, row_axes)
+        return c
+
+    # feature (column) shards gather: the kernel needs full rows, matching
+    # the reference's TP activation gather semantics
+    arg_shardings = (NamedSharding(mesh, P(row_axes, None)),)
+    return mesh, lower, NamedSharding(mesh, P()), arg_shardings
+
+
+sym_cov_spmd.def_partition(
+    infer_sharding_from_operands=_spmd_infer,
+    partition=_spmd_partition,
+    # distinct output factors: C's two dims never inherit the (gathered)
+    # feature sharding; the contracted row factor n drives the psum
+    sharding_rule='n d1 -> d1 d2',
+)
 
 
 def use_pallas_for(d: int) -> bool:

@@ -224,15 +224,33 @@ class DistributedKFAC:
         """
         cfg = self.config
         a_stacks, g_stacks = {}, {}
+        # Pin each captured factor to replicated BEFORE stacking: under
+        # GSPMD the capture contraction can leave per-layer covariances with
+        # inferred shardings over model/seq axes, and concatenating
+        # mixed-sharding rows forces XLA's "involuntary full
+        # rematerialization" (replicate the whole stack, then re-slice).
+        # All-gathering each small (d, d) matrix first makes the stack's
+        # reshard to the slot-sharded factor layout a local slice.
+        rep = NamedSharding(self.mesh, P())
         for b in self.buckets:
             a_rows, g_rows = [], []
             for i, n in enumerate(b.layers):
                 if n in stats.a:
-                    a_rows.append(stats.a[n].astype(cfg.factor_dtype))
-                    g_rows.append(stats.g[n].astype(cfg.factor_dtype))
+                    a_rows.append(jax.lax.with_sharding_constraint(
+                        stats.a[n].astype(cfg.factor_dtype), rep
+                    ))
+                    g_rows.append(jax.lax.with_sharding_constraint(
+                        stats.g[n].astype(cfg.factor_dtype), rep
+                    ))
                 else:
-                    a_rows.append(state.a[b.key][i])
-                    g_rows.append(state.g[b.key][i])
+                    # state slices are factor-sharded — pin them too so the
+                    # stack never mixes shardings
+                    a_rows.append(jax.lax.with_sharding_constraint(
+                        state.a[b.key][i], rep
+                    ))
+                    g_rows.append(jax.lax.with_sharding_constraint(
+                        state.g[b.key][i], rep
+                    ))
             pad = b.padded - len(b.layers)
             if pad:
                 a_rows += [jnp.eye(b.da, dtype=cfg.factor_dtype)] * pad
@@ -362,8 +380,15 @@ class DistributedKFAC:
         pmats: dict[str, jax.Array] = {}
         vg = jnp.zeros((), jnp.float32)
         for b in self.buckets:
+            # pin each matrix to replicated before stacking: TP/SP leaves
+            # per-layer grads model-sharded, and a mixed-sharding concat
+            # forces XLA's involuntary full rematerialization of the stack
+            # (same pattern as _stack_stats)
             rows = [
-                self.registry.layers[n].grads_to_matrix(layer_grads[n])
+                jax.lax.with_sharding_constraint(
+                    self.registry.layers[n].grads_to_matrix(layer_grads[n]),
+                    rep,
+                )
                 for n in b.layers
             ]
             pad = b.padded - len(b.layers)
