@@ -52,6 +52,18 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument('--seed', type=int, default=42)
     g.add_argument('--data-dir', default=None)
     g.add_argument('--checkpoint-dir', default=None)
+    g.add_argument(
+        '--resume', action='store_true',
+        help='resume from the latest checkpoint in --checkpoint-dir',
+    )
+    g.add_argument(
+        '--augment', action='store_true', default=None,
+        help='random crop + flip on training images (default: on when '
+             'training on a real dataset)',
+    )
+    g.add_argument(
+        '--no-augment', dest='augment', action='store_false'
+    )
     g.add_argument('--bf16', action='store_true')
     g.add_argument('--limit-steps', type=int, default=None,
                    help='cap steps per epoch (smoke runs)')
@@ -147,6 +159,61 @@ def build_kfac(args, registry, mesh=None, lr=None):
     return cfg
 
 
+def make_epoch_batches(
+    args,
+    x_train,
+    y_train,
+    augment: bool,
+    start_epoch: int = 0,
+    normalize_stats=None,
+):
+    """Shared trainer input pipeline: native prefetch loader when requested
+    (with in-worker crop/flip and shuffle fast-forward to ``start_epoch``
+    for resumed runs), else seeded python batches with numpy augmentation.
+    ``normalize_stats=(mean, std)`` applies per-batch normalization — used
+    when the source is a read-only memmap that cannot be normalized in
+    place. Returns ``epoch_batches(epoch)``.
+    """
+    from examples import data as data_lib
+
+    prefetcher = None
+    if getattr(args, 'native_loader', False):
+        from kfac_tpu.utils import native_loader
+
+        try:
+            prefetcher = native_loader.PrefetchLoader(
+                x_train, y_train, batch_size=args.batch_size, seed=args.seed,
+                augment={'pad': 4, 'flip': True} if augment else None,
+                start_epoch=start_epoch,
+            )
+        except native_loader.NativeLoaderUnavailable as e:
+            print(f'native loader unavailable ({e}); using python batches')
+
+    def epoch_batches(epoch):
+        import numpy as np
+
+        if prefetcher is not None:
+            it = prefetcher.epoch_batches()
+            aug_rng = None  # augmentation happened in the worker
+        else:
+            it = data_lib.batches(
+                x_train, y_train, args.batch_size, args.seed + epoch
+            )
+            aug_rng = (
+                np.random.default_rng(args.seed * 1000 + epoch)
+                if augment
+                else None
+            )
+        for xb, yb in it:
+            if aug_rng is not None:
+                xb = data_lib.augment_images(xb, aug_rng)
+            if normalize_stats is not None:
+                xb = data_lib.normalize(xb, *normalize_stats)
+            yield xb, yb
+
+    return epoch_batches
+
+
 class Timer:
     def __init__(self) -> None:
         self.start = time.perf_counter()
@@ -155,19 +222,112 @@ class Timer:
         return time.perf_counter() - self.start
 
 
-def save_checkpoint(checkpoint_dir, state) -> None:
-    """Write params (always) and K-FAC factors (when enabled) via orbax."""
+def _extra_payload(state, epoch: int):
+    """Everything beyond the K-FAC durable state needed to resume exactly:
+    params, optimizer state (momentum), mutable model state (batch_stats),
+    and the epoch to restart from."""
+    import numpy as np
+
+    extra = {
+        'params': state.params,
+        'opt_state': state.opt_state,
+        'epoch': np.asarray(epoch, np.int32),
+    }
+    if state.model_state is not None:
+        extra['model_state'] = state.model_state
+    return extra
+
+
+def _epoch_dir(checkpoint_dir: str, epoch: int) -> str:
+    import os
+
+    return os.path.join(os.path.abspath(checkpoint_dir), f'e{epoch:05d}')
+
+
+def save_checkpoint(checkpoint_dir, state, epoch: int = 0) -> None:
+    """Write the full training state via orbax into an epoch-versioned
+    subdirectory (the reference keeps per-epoch files and resumes the
+    latest, examples/torch_cifar10_resnet.py:313-354)."""
     from kfac_tpu import checkpoint
 
+    path = _epoch_dir(checkpoint_dir, epoch)
+    extra = _extra_payload(state, epoch)
     if state.kfac_state is not None:
-        checkpoint.save(
-            checkpoint_dir + '/kfac', state.kfac_state,
-            extra={'params': state.params},
+        checkpoint.save(path + '/kfac', state.kfac_state, extra=extra)
+    else:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path + '/plain', extra)
+        ckptr.wait_until_finished()
+    print(f'checkpoint written to {path}')
+
+
+def latest_checkpoint(checkpoint_dir) -> tuple[str, int] | None:
+    """Scan for the newest epoch-versioned checkpoint; None if absent."""
+    import os
+    import re
+
+    root = os.path.abspath(checkpoint_dir)
+    if not os.path.isdir(root):
+        return None
+    epochs = [
+        int(m.group(1))
+        for d in os.listdir(root)
+        if (m := re.fullmatch(r'e(\d+)', d))
+    ]
+    # newest epoch whose payload actually committed (orbax writes the
+    # kfac/plain subdir atomically by rename; a bare eNNNNN dir means the
+    # process died mid-save — fall back to the previous complete one)
+    for e in sorted(epochs, reverse=True):
+        path = _epoch_dir(checkpoint_dir, e)
+        if os.path.isdir(os.path.join(path, 'kfac')) or os.path.isdir(
+            os.path.join(path, 'plain')
+        ):
+            return path, e
+    return None
+
+
+def restore_checkpoint(checkpoint_dir, state_template, kfac_engine):
+    """Restore the latest checkpoint into ``state_template``'s structure.
+
+    Returns ``(state, next_epoch)`` or None when no checkpoint exists.
+    K-FAC decompositions are recomputed from the restored factors
+    (reference semantics: derived state is not persisted,
+    kfac/base_preconditioner.py:215-308).
+    """
+    from kfac_tpu import checkpoint
+
+    found = latest_checkpoint(checkpoint_dir)
+    if found is None:
+        return None
+    path, epoch = found
+    extra_t = _extra_payload(state_template, 0)
+    if state_template.kfac_state is not None:
+        kstate, extra = checkpoint.restore(
+            path + '/kfac', kfac_engine, extra_template=extra_t
         )
     else:
         import orbax.checkpoint as ocp
 
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(checkpoint_dir + '/params', {'params': state.params})
-        ckptr.wait_until_finished()
-    print(f'checkpoint written to {checkpoint_dir}')
+        extra = ckptr.restore(path + '/plain', target=extra_t)
+        kstate = None
+    mesh = getattr(kfac_engine, 'mesh', None)
+    if mesh is not None:
+        # orbax returns committed single-device arrays; replicate them over
+        # the training mesh so they compose with the sharded K-FAC state
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        extra = jax.tree_util.tree_map(
+            lambda r: jax.device_put(r, rep), extra
+        )
+    state = state_template._replace(
+        params=extra['params'],
+        opt_state=extra['opt_state'],
+        kfac_state=kstate,
+        model_state=extra.get('model_state', state_template.model_state),
+    )
+    print(f'resumed from {path} (epoch {epoch})')
+    return state, epoch + 1

@@ -32,6 +32,28 @@ def synthetic_classification(
     return x.astype(np.float32), labels.astype(np.int32)
 
 
+def cifar_on_disk(data_dir: str | None) -> bool:
+    """Whether :func:`cifar10` would load a real dataset from data_dir."""
+    return bool(data_dir) and os.path.exists(
+        os.path.join(data_dir, 'cifar10.npz')
+    )
+
+
+def imagenet_on_disk(data_dir: str | None) -> bool:
+    """Whether :func:`imagenet_like` would load real data (memmap .npy
+    layout needs all four files, else the .npz)."""
+    if not data_dir:
+        return False
+    mm = [
+        os.path.join(data_dir, f'imagenet_{k}_{s}.npy')
+        for k in ('x', 'y')
+        for s in ('train', 'test')
+    ]
+    return all(os.path.exists(f) for f in mm) or os.path.exists(
+        os.path.join(data_dir, 'imagenet.npz')
+    )
+
+
 def cifar10(data_dir: str | None = None, n_train: int = 50000, n_test: int = 10000):
     """(32, 32, 3) x 10 classes; loads ``cifar10.npz`` from data_dir if
     present (keys: x_train, y_train, x_test, y_test), else synthetic."""
@@ -55,8 +77,32 @@ def imagenet_like(
     n_test: int = 1000,
     num_classes: int = 1000,
 ):
-    """ImageNet-shaped data ((S, S, 3) x 1000)."""
+    """ImageNet-shaped data ((S, S, 3) x 1000).
+
+    Preferred on-disk layout: ``imagenet_{x,y}_{train,test}.npy`` with x as
+    C-contiguous float32 — x is memory-mapped so the native loader's worker
+    reads pages straight from disk (no RAM copy of the dataset), the
+    equivalent of the reference's folder-of-JPEGs DataLoader at the tensor
+    level. Falls back to ``imagenet.npz`` (loaded into RAM), then synthetic.
+    """
     if data_dir:
+        mm_files = [
+            os.path.join(data_dir, f'imagenet_{k}_{s}.npy')
+            for k in ('x', 'y')
+            for s in ('train', 'test')
+        ]
+        if all(os.path.exists(f) for f in mm_files):
+            def load(split):
+                x = np.load(
+                    os.path.join(data_dir, f'imagenet_x_{split}.npy'),
+                    mmap_mode='r',
+                )
+                y = np.load(
+                    os.path.join(data_dir, f'imagenet_y_{split}.npy')
+                ).astype(np.int32)
+                return x, y
+
+            return load('train'), load('test')
         path = os.path.join(data_dir, 'imagenet.npz')
         if os.path.exists(path):
             z = np.load(path)
@@ -100,6 +146,36 @@ def lm_corpus(
     toks = rng.zipf(1.3, size=n_tokens).astype(np.int64)
     toks = np.clip(toks, 1, vocab_size - 1).astype(np.int32)
     return toks, vocab_size
+
+
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def normalize(x: np.ndarray, mean: np.ndarray, std: np.ndarray) -> np.ndarray:
+    """Per-channel normalization of (..., H, W, C) images (the reference's
+    transforms.Normalize, examples/vision/datasets.py)."""
+    return ((x - mean) / std).astype(np.float32)
+
+
+def augment_images(
+    x: np.ndarray, rng: np.random.Generator, pad: int = 4, flip: bool = True
+) -> np.ndarray:
+    """Random pad-crop + horizontal flip for a batch of (H, W, C) images —
+    the numpy fallback for the native loader's in-worker augmentation
+    (reference: RandomCrop(padding=4) + RandomHorizontalFlip)."""
+    n, h, w, _ = x.shape
+    padded = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    dy = rng.integers(0, 2 * pad + 1, size=n)
+    dx = rng.integers(0, 2 * pad + 1, size=n)
+    do_flip = flip & (rng.integers(0, 2, size=n) == 1)
+    out = np.empty_like(x)
+    for i in range(n):
+        img = padded[i, dy[i] : dy[i] + h, dx[i] : dx[i] + w]
+        out[i] = img[:, ::-1] if do_flip[i] else img
+    return out
 
 
 def batches(x, y, batch_size: int, seed: int, drop_last: bool = True):

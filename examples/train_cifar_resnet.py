@@ -47,7 +47,15 @@ def main(argv=None) -> float:
     mesh = kaisa_mesh(grad_worker_fraction=frac)
     bs = batch_sharding(mesh)
 
+    real_data = data.cifar_on_disk(args.data_dir)
     (x_train, y_train), (x_test, y_test) = data.cifar10(args.data_dir)
+    if real_data:
+        # reference order: augment RAW pixels, then normalize (crop borders
+        # become (0-mean)/std, not 0) — train normalization happens
+        # per-batch in make_epoch_batches below; eval sees no augmentation
+        # so its split normalizes up front
+        x_test = data.normalize(x_test, data.CIFAR10_MEAN, data.CIFAR10_STD)
+    augment = real_data if args.augment is None else args.augment
     model = getattr(resnet, args.model)(
         num_classes=10, dtype=jnp.bfloat16 if args.bf16 else jnp.float32
     )
@@ -88,27 +96,23 @@ def main(argv=None) -> float:
     )
     state = trainer.init(variables['params'], variables['batch_stats'])
 
-    prefetcher = None
-    if args.native_loader:
-        from kfac_tpu.utils import native_loader
+    start_epoch = 0
+    if args.resume and args.checkpoint_dir:
+        restored = common.restore_checkpoint(args.checkpoint_dir, state, kfac)
+        if restored is not None:
+            state, start_epoch = restored
+            trainer.resume(state)
 
-        try:
-            prefetcher = native_loader.PrefetchLoader(
-                x_train, y_train, batch_size=args.batch_size, seed=args.seed
-            )
-        except native_loader.NativeLoaderUnavailable as e:
-            print(f'native loader unavailable ({e}); using python batches')
-
-    def epoch_batches(epoch):
-        if prefetcher is not None:
-            return prefetcher.epoch_batches()
-        return data.batches(
-            x_train, y_train, args.batch_size, args.seed + epoch
-        )
+    epoch_batches = common.make_epoch_batches(
+        args, x_train, y_train, augment, start_epoch=start_epoch,
+        normalize_stats=(
+            (data.CIFAR10_MEAN, data.CIFAR10_STD) if real_data else None
+        ),
+    )
 
     timer = common.Timer()
     test_acc = 0.0
-    for epoch in range(args.epochs):
+    for epoch in range(start_epoch, args.epochs):
         train_loss = common.Metric()
         for step, (xb, yb) in enumerate(epoch_batches(epoch)):
             if args.limit_steps and step >= args.limit_steps:
@@ -136,9 +140,8 @@ def main(argv=None) -> float:
             f'epoch {epoch}: train_loss={train_loss.avg:.4f} '
             f'test_acc={test_acc:.4f} elapsed={timer.elapsed():.1f}s'
         )
-
-    if args.checkpoint_dir:
-        common.save_checkpoint(args.checkpoint_dir, state)
+        if args.checkpoint_dir:
+            common.save_checkpoint(args.checkpoint_dir, state, epoch)
     return test_acc
 
 

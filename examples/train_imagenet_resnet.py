@@ -28,6 +28,11 @@ def main(argv=None) -> float:
     p = argparse.ArgumentParser(description='ImageNet ResNet-50 + K-FAC')
     p.add_argument('--image-size', type=int, default=224)
     p.add_argument('--label-smoothing', type=float, default=0.1)
+    p.add_argument(
+        '--native-loader', action='store_true',
+        help='C++ prefetch loader; reads memory-mapped imagenet_x_train.npy '
+             'directly from disk with in-worker crop/flip augmentation',
+    )
     common.add_train_args(p)
     common.add_kfac_args(p)
     args = p.parse_args(argv)
@@ -37,10 +42,12 @@ def main(argv=None) -> float:
     mesh = kaisa_mesh(grad_worker_fraction=frac)
     bs = batch_sharding(mesh)
 
+    real_data = data.imagenet_on_disk(args.data_dir)
     (x_train, y_train), (x_test, y_test) = data.imagenet_like(
         args.data_dir, image_size=args.image_size,
         n_train=max(args.batch_size * 8, 1024), n_test=args.batch_size * 2,
     )
+    augment = real_data if args.augment is None else args.augment
     model = resnet.resnet50(
         num_classes=1000, dtype=jnp.bfloat16 if args.bf16 else jnp.float32
     )
@@ -79,14 +86,29 @@ def main(argv=None) -> float:
     )
     state = trainer.init(variables['params'], variables['batch_stats'])
 
+    start_epoch = 0
+    if args.resume and args.checkpoint_dir:
+        restored = common.restore_checkpoint(args.checkpoint_dir, state, kfac)
+        if restored is not None:
+            state, start_epoch = restored
+            trainer.resume(state)
+
+    # x_train may be a read-only float32 memmap (the native loader's worker
+    # then reads pages straight from disk), so normalization happens
+    # per-batch rather than in place
+    epoch_batches = common.make_epoch_batches(
+        args, x_train, y_train, augment, start_epoch=start_epoch,
+        normalize_stats=(
+            (data.IMAGENET_MEAN, data.IMAGENET_STD) if real_data else None
+        ),
+    )
+
     acc_val = 0.0
-    for epoch in range(args.epochs):
+    for epoch in range(start_epoch, args.epochs):
         epoch_timer = common.Timer()
         train_loss = common.Metric()
         n_steps = 0
-        for step, (xb, yb) in enumerate(
-            data.batches(x_train, y_train, args.batch_size, args.seed + epoch)
-        ):
+        for step, (xb, yb) in enumerate(epoch_batches(epoch)):
             if args.limit_steps and step >= args.limit_steps:
                 break
             batch = (
@@ -103,6 +125,8 @@ def main(argv=None) -> float:
         ):
             if args.limit_steps and eval_step >= args.limit_steps:
                 break
+            if real_data:
+                xb = data.normalize(xb, data.IMAGENET_MEAN, data.IMAGENET_STD)
             logits = model.apply(
                 {'params': state.params, 'batch_stats': state.model_state},
                 jnp.asarray(xb), train=False,
@@ -114,8 +138,8 @@ def main(argv=None) -> float:
             f'epoch {epoch}: loss={train_loss.avg:.4f} acc={acc_val:.4f} '
             f'{imgs / max(train_secs, 1e-9):.1f} img/s'
         )
-    if args.checkpoint_dir:
-        common.save_checkpoint(args.checkpoint_dir, state)
+        if args.checkpoint_dir:
+            common.save_checkpoint(args.checkpoint_dir, state, epoch)
     return acc_val
 
 

@@ -91,10 +91,17 @@ def main(argv=None) -> float:
     )
     state = trainer.init(params)
 
+    start_epoch = 0
+    if args.resume and args.checkpoint_dir:
+        restored = common.restore_checkpoint(args.checkpoint_dir, state, kfac)
+        if restored is not None:
+            state, start_epoch = restored
+            trainer.resume(state)
+
     ts = token_sharding(mesh)
     timer = common.Timer()
     final_ppl = float('inf')
-    for epoch in range(args.epochs):
+    for epoch in range(start_epoch, args.epochs):
         lm = common.Metric()
         for step, (xb, yb) in enumerate(
             data.lm_batches(tokens_np, args.batch_size, args.seq_len,
@@ -113,8 +120,8 @@ def main(argv=None) -> float:
             f'epoch {epoch}: train_loss={lm.avg:.4f} ppl={final_ppl:.1f} '
             f'elapsed={timer.elapsed():.1f}s'
         )
-    if args.checkpoint_dir:
-        common.save_checkpoint(args.checkpoint_dir, state)
+        if args.checkpoint_dir:
+            common.save_checkpoint(args.checkpoint_dir, state, epoch)
     return final_ppl
 
 

@@ -125,7 +125,9 @@ def _spmd_infer(mesh, arg_shapes, result_shape):
 
 def _spmd_partition(mesh, arg_shapes, result_shape):
     del result_shape
-    row_axes = arg_shapes[0].sharding.spec[0]
+    spec = arg_shapes[0].sharding.spec
+    # fully-replicated inputs arrive as the rank-0 PartitionSpec()
+    row_axes = spec[0] if len(spec) > 0 else None
 
     def lower(a):
         c = sym_cov(a, scale=1.0, interpret=interpret_mode())
@@ -142,9 +144,9 @@ def _spmd_partition(mesh, arg_shapes, result_shape):
 sym_cov_spmd.def_partition(
     infer_sharding_from_operands=_spmd_infer,
     partition=_spmd_partition,
-    # distinct output factors: C's two dims never inherit the (gathered)
-    # feature sharding; the contracted row factor n drives the psum
-    sharding_rule='n d1 -> d1 d2',
+    # fresh output factors: C's dims never inherit the (gathered) feature
+    # sharding of d1; the contracted row factor n drives the psum
+    sharding_rule='n d1 -> d2 d3',
 )
 
 

@@ -1,47 +1,22 @@
-"""Collective primitives and transport utilities.
+"""Symmetric/bucketed factor transport utilities.
 
 The reference wraps ``torch.distributed`` in an async future-returning
 communicator (kfac/distributed.py:124-385). Under XLA there is no user-level
 async plumbing — collectives are ops the compiler schedules and overlaps —
-so the parity surface here is thin named wrappers used inside ``shard_map``
-blocks plus the symmetric-triangle packing used to halve factor transport
-(reference get_triu/fill_triu: kfac/distributed.py:422-465).
-
-Bucketed/fused allreduce (kfac/distributed.py:305-374) is intentionally a
-no-op concept on TPU: XLA's combiner fuses small collectives; where explicit
-fusion helps (DCN), pack with :func:`concat_flat` before a single psum.
+so the named-wrapper layer dissolves entirely; what remains is the
+*transport encoding*: the symmetric-triangle packing that halves factor
+bytes (reference get_triu/fill_triu: kfac/distributed.py:422-465) and the
+flat-buffer bucketing that trades many small collectives for one large one
+(reference 25MB buckets: kfac/distributed.py:305-374). Both are engaged by
+``DistributedKFAC`` when the preconditioner is configured with
+``AllreduceMethod.ALLREDUCE_BUCKETED`` (kfac_tpu/parallel/kaisa.py
+``_stack_stats``), the right trade on DCN-bound multihost meshes.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-
-
-def psum_mean(x, axis_name):
-    """All-reduce average over a mesh axis (factor allreduce semantics:
-    reference kfac/layers/base.py:282-336 divides by group size)."""
-    return jax.lax.psum(x, axis_name) / jax.lax.psum(1, axis_name)
-
-
-def all_gather_axis(x, axis_name, axis=0, tiled=True):
-    """Gather shards along a mesh axis into every member."""
-    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
-
-
-def broadcast_from(x, axis_name, src_index=0):
-    """Select one member's value for the whole axis (torch broadcast
-    equivalent; reference kfac/distributed.py:248-303). Implemented as a
-    psum of a masked value — on TPU this lowers to an efficient all-reduce
-    over ICI rather than a rooted tree broadcast."""
-    idx = jax.lax.axis_index(axis_name)
-    masked = jnp.where(idx == src_index, x, jnp.zeros_like(x))
-    return jax.lax.psum(masked, axis_name)
-
-
-def reduce_scatter_axis(x, axis_name, axis=0):
-    """Reduce-scatter along a mesh axis."""
-    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
 
 
 # ---------------------------------------------------------------- triangles

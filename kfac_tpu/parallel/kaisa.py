@@ -39,6 +39,7 @@ from kfac_tpu import enums
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
 from kfac_tpu.ops import factors as factors_lib
+from kfac_tpu.parallel import collectives
 from kfac_tpu.parallel import mesh as mesh_lib
 from kfac_tpu.preconditioner import KFACPreconditioner, _resolve
 
@@ -72,6 +73,42 @@ def build_buckets(registry: registry_lib.Registry, world: int) -> list[Bucket]:
             )
         )
     return buckets
+
+
+class StorageBucket(NamedTuple):
+    """One side's (A or G) factor storage: layers stacked along slots.
+
+    With ``colocate_factors=True`` these mirror the (da, dg) pair buckets,
+    so a layer's A and G share a slot index (same owning device). With
+    ``False`` each side groups by its own dimension only — A and G of one
+    layer can land in different stacks/slots, splitting its two
+    eigendecompositions across devices (reference
+    kfac/assignment.py:268-304).
+    """
+
+    key: str
+    layers: tuple[str, ...]
+    d: int
+    padded: int
+
+
+def build_side_buckets(
+    registry: registry_lib.Registry, world: int, side: str
+) -> list[StorageBucket]:
+    """Group layers by a single factor dimension (non-colocated storage)."""
+    groups: dict[int, list[str]] = {}
+    for name, h in registry.layers.items():
+        d = h.a_factor_shape[0] if side == 'a' else h.g_factor_shape[0]
+        groups.setdefault(d, []).append(name)
+    return [
+        StorageBucket(
+            key=f'{side}{d}',
+            layers=tuple(names),
+            d=d,
+            padded=-(-len(names) // world) * world,
+        )
+        for d, names in sorted(groups.items())
+    ]
 
 
 class DistKFACState(NamedTuple):
@@ -116,15 +153,53 @@ class DistributedKFAC:
             self.world, self.grad_workers / self.world
         )
         self.buckets = build_buckets(self.registry, self.total_devices)
+        self.colocate = bool(self.config.colocate_factors)
         # Parity object: cost-model view of the placement for reporting and
-        # for API compatibility with the reference's query surface.
+        # for API compatibility with the reference's query surface (also
+        # enforces MEM-OPT => colocated, as the reference does).
         self.assignment = assignment_lib.KAISAAssignment(
             assignment_lib.compute_work_costs(self.registry.layers),
             world_size=self.world,
             grad_worker_fraction=self.grad_workers / self.world,
+            colocate_factors=self.colocate,
         )
+        # Factor STORAGE layout: colocated mirrors the (da, dg) pair
+        # buckets (A and G share a slot/device); non-colocated buckets each
+        # side by its own dimension so a layer's two eigendecompositions
+        # can run on different devices (reference kfac/assignment.py:268-304).
+        if self.colocate:
+            self.a_store = [
+                StorageBucket(b.key, b.layers, b.da, b.padded)
+                for b in self.buckets
+            ]
+            self.g_store = [
+                StorageBucket(b.key, b.layers, b.dg, b.padded)
+                for b in self.buckets
+            ]
+        else:
+            self.a_store = build_side_buckets(
+                self.registry, self.total_devices, 'a'
+            )
+            self.g_store = build_side_buckets(
+                self.registry, self.total_devices, 'g'
+            )
+        self._a_slot = {
+            n: (sb.key, i)
+            for sb in self.a_store
+            for i, n in enumerate(sb.layers)
+        }
+        self._g_slot = {
+            n: (sb.key, i)
+            for sb in self.g_store
+            for i, n in enumerate(sb.layers)
+        }
         self._eigen = self.config.compute_method == enums.ComputeMethod.EIGEN
         self._prediv = self._eigen and self.config.prediv_eigenvalues
+        if self._prediv and not self.colocate:
+            raise NotImplementedError(
+                'prediv_eigenvalues stores the fused per-layer eigenvalue '
+                'grid, which requires colocate_factors=True'
+            )
         if self.config.prediv_eigenvalues and not self._eigen:
             import warnings as _warnings
 
@@ -154,21 +229,24 @@ class DistributedKFAC:
         dec = NamedSharding(self.mesh, self._decomp_spec())
         rep = NamedSharding(self.mesh, P())
 
-        def bdict(sh):
-            return {b.key: sh for b in self.buckets}
+        def adict(sh):
+            return {sb.key: sh for sb in self.a_store}
+
+        def gdict(sh):
+            return {sb.key: sh for sb in self.g_store}
 
         eigen = self._eigen
         return DistKFACState(
             step=rep,
-            a=bdict(fac),
-            g=bdict(fac),
-            qa=bdict(dec) if eigen else {},
-            qg=bdict(dec) if eigen else {},
-            da=bdict(dec) if eigen and not self._prediv else {},
-            dg=bdict(dec) if eigen and not self._prediv else {},
-            dgda=bdict(dec) if self._prediv else {},
-            a_inv={} if eigen else bdict(dec),
-            g_inv={} if eigen else bdict(dec),
+            a=adict(fac),
+            g=gdict(fac),
+            qa=adict(dec) if eigen else {},
+            qg=gdict(dec) if eigen else {},
+            da=adict(dec) if eigen and not self._prediv else {},
+            dg=gdict(dec) if eigen and not self._prediv else {},
+            dgda={b.key: dec for b in self.buckets} if self._prediv else {},
+            a_inv={} if eigen else adict(dec),
+            g_inv={} if eigen else gdict(dec),
         )
 
     # ----------------------------------------------------------------- init
@@ -179,28 +257,41 @@ class DistributedKFAC:
         def build() -> DistKFACState:
             cfg = self.config
             a, g, qa, qg, da, dg, dgda, a_inv, g_inv = ({} for _ in range(9))
-            for b in self.buckets:
-                eye_a = jnp.broadcast_to(
-                    jnp.eye(b.da, dtype=cfg.factor_dtype), (b.padded, b.da, b.da)
+            for sb in self.a_store:
+                a[sb.key] = jnp.broadcast_to(
+                    jnp.eye(sb.d, dtype=cfg.factor_dtype),
+                    (sb.padded, sb.d, sb.d),
                 )
-                eye_g = jnp.broadcast_to(
-                    jnp.eye(b.dg, dtype=cfg.factor_dtype), (b.padded, b.dg, b.dg)
-                )
-                a[b.key] = eye_a
-                g[b.key] = eye_g
                 if self._eigen:
-                    qa[b.key] = jnp.zeros((b.padded, b.da, b.da), cfg.inv_dtype)
-                    qg[b.key] = jnp.zeros((b.padded, b.dg, b.dg), cfg.inv_dtype)
-                    if self._prediv:
-                        dgda[b.key] = jnp.zeros(
-                            (b.padded, b.dg, b.da), cfg.inv_dtype
-                        )
-                    else:
-                        da[b.key] = jnp.zeros((b.padded, b.da), cfg.inv_dtype)
-                        dg[b.key] = jnp.zeros((b.padded, b.dg), cfg.inv_dtype)
+                    qa[sb.key] = jnp.zeros(
+                        (sb.padded, sb.d, sb.d), cfg.inv_dtype
+                    )
+                    if not self._prediv:
+                        da[sb.key] = jnp.zeros((sb.padded, sb.d), cfg.inv_dtype)
                 else:
-                    a_inv[b.key] = jnp.zeros((b.padded, b.da, b.da), cfg.inv_dtype)
-                    g_inv[b.key] = jnp.zeros((b.padded, b.dg, b.dg), cfg.inv_dtype)
+                    a_inv[sb.key] = jnp.zeros(
+                        (sb.padded, sb.d, sb.d), cfg.inv_dtype
+                    )
+            for sb in self.g_store:
+                g[sb.key] = jnp.broadcast_to(
+                    jnp.eye(sb.d, dtype=cfg.factor_dtype),
+                    (sb.padded, sb.d, sb.d),
+                )
+                if self._eigen:
+                    qg[sb.key] = jnp.zeros(
+                        (sb.padded, sb.d, sb.d), cfg.inv_dtype
+                    )
+                    if not self._prediv:
+                        dg[sb.key] = jnp.zeros((sb.padded, sb.d), cfg.inv_dtype)
+                else:
+                    g_inv[sb.key] = jnp.zeros(
+                        (sb.padded, sb.d, sb.d), cfg.inv_dtype
+                    )
+            if self._prediv:
+                for b in self.buckets:
+                    dgda[b.key] = jnp.zeros(
+                        (b.padded, b.dg, b.da), cfg.inv_dtype
+                    )
             return DistKFACState(
                 step=jnp.asarray(0, jnp.int32),
                 a=a, g=g, qa=qa, qg=qg, da=da, dg=dg, dgda=dgda,
@@ -223,41 +314,69 @@ class DistributedKFAC:
         hooks, which simply never fire for unexecuted modules.
         """
         cfg = self.config
-        a_stacks, g_stacks = {}, {}
+        bucketed = (
+            cfg.allreduce_method == enums.AllreduceMethod.ALLREDUCE_BUCKETED
+        )
         # Pin each captured factor to replicated BEFORE stacking: under
         # GSPMD the capture contraction can leave per-layer covariances with
         # inferred shardings over model/seq axes, and concatenating
         # mixed-sharding rows forces XLA's "involuntary full
         # rematerialization" (replicate the whole stack, then re-slice).
-        # All-gathering each small (d, d) matrix first makes the stack's
-        # reshard to the slot-sharded factor layout a local slice.
+        # ALLREDUCE pins (all-gathers) each small (d, d) matrix on its own;
+        # ALLREDUCE_BUCKETED packs the upper triangles of every factor into
+        # one flat buffer and pins that — one large collective carrying
+        # half the bytes (factors are symmetric), the reference's bucketed
+        # symmetric transport (kfac/distributed.py:305-374, 422-465) for
+        # DCN-bound multihost meshes.
         rep = NamedSharding(self.mesh, P())
-        for b in self.buckets:
-            a_rows, g_rows = [], []
-            for i, n in enumerate(b.layers):
-                if n in stats.a:
-                    a_rows.append(jax.lax.with_sharding_constraint(
-                        stats.a[n].astype(cfg.factor_dtype), rep
-                    ))
-                    g_rows.append(jax.lax.with_sharding_constraint(
-                        stats.g[n].astype(cfg.factor_dtype), rep
-                    ))
-                else:
-                    # state slices are factor-sharded — pin them too so the
-                    # stack never mixes shardings
-                    a_rows.append(jax.lax.with_sharding_constraint(
-                        state.a[b.key][i], rep
-                    ))
-                    g_rows.append(jax.lax.with_sharding_constraint(
-                        state.g[b.key][i], rep
-                    ))
-            pad = b.padded - len(b.layers)
-            if pad:
-                a_rows += [jnp.eye(b.da, dtype=cfg.factor_dtype)] * pad
-                g_rows += [jnp.eye(b.dg, dtype=cfg.factor_dtype)] * pad
-            a_stacks[b.key] = jnp.stack(a_rows)
-            g_stacks[b.key] = jnp.stack(g_rows)
-        return a_stacks, g_stacks
+
+        def pin(m):
+            return m if bucketed else jax.lax.with_sharding_constraint(m, rep)
+
+        def side_rows(store, side_stats, side_state):
+            rows: dict[str, list] = {}
+            for sb in store:
+                r = []
+                for i, n in enumerate(sb.layers):
+                    if n in side_stats:
+                        r.append(pin(side_stats[n].astype(cfg.factor_dtype)))
+                    else:
+                        # state slices are factor-sharded — pin them too so
+                        # the stack never mixes shardings
+                        r.append(pin(side_state[sb.key][i]))
+                rows[sb.key] = r
+            return rows
+
+        rows_a = side_rows(self.a_store, stats.a, state.a)
+        rows_g = side_rows(self.g_store, stats.g, state.g)
+
+        if bucketed:
+            flat_rows = [
+                m for sb in self.a_store for m in rows_a[sb.key]
+            ] + [m for sb in self.g_store for m in rows_g[sb.key]]
+            tris = [collectives.get_triu(m) for m in flat_rows]
+            flat, specs = collectives.concat_flat(tris)
+            flat = jax.lax.with_sharding_constraint(flat, rep)
+            unpacked = iter(
+                collectives.fill_triu(m.shape, t)
+                for m, t in zip(flat_rows, collectives.split_flat(flat, specs))
+            )
+            for sb in self.a_store:  # same order as flat_rows: a then g
+                rows_a[sb.key] = [next(unpacked) for _ in rows_a[sb.key]]
+            for sb in self.g_store:
+                rows_g[sb.key] = [next(unpacked) for _ in rows_g[sb.key]]
+
+        def stack_side(store, rows):
+            stacks = {}
+            for sb in store:
+                r = rows[sb.key]
+                pad = sb.padded - len(sb.layers)
+                if pad:
+                    r = r + [jnp.eye(sb.d, dtype=cfg.factor_dtype)] * pad
+                stacks[sb.key] = jnp.stack(r)
+            return stacks
+
+        return stack_side(self.a_store, rows_a), stack_side(self.g_store, rows_g)
 
     # ------------------------------------------------------- factor updates
 
@@ -272,18 +391,19 @@ class DistributedKFAC:
         """
         alpha = _resolve(self.config.factor_decay, state.step)
         a_stacks, g_stacks = self._stack_stats(state, stats)
-        spec = self._factor_spec()
-        new_a, new_g = {}, {}
-        for b in self.buckets:
-            sa = jax.lax.with_sharding_constraint(
-                a_stacks[b.key], NamedSharding(self.mesh, spec)
-            )
-            sg = jax.lax.with_sharding_constraint(
-                g_stacks[b.key], NamedSharding(self.mesh, spec)
-            )
-            new_a[b.key] = alpha * state.a[b.key] + (1 - alpha) * sa
-            new_g[b.key] = alpha * state.g[b.key] + (1 - alpha) * sg
-        return state._replace(a=new_a, g=new_g)
+        fac = NamedSharding(self.mesh, self._factor_spec())
+
+        def ema(store, side_state, stacks):
+            out = {}
+            for sb in store:
+                s = jax.lax.with_sharding_constraint(stacks[sb.key], fac)
+                out[sb.key] = alpha * side_state[sb.key] + (1 - alpha) * s
+            return out
+
+        return state._replace(
+            a=ema(self.a_store, state.a, a_stacks),
+            g=ema(self.g_store, state.g, g_stacks),
+        )
 
     # ------------------------------------------------------------- inverses
 
@@ -327,36 +447,56 @@ class DistributedKFAC:
         dec = NamedSharding(self.mesh, self._decomp_spec())
         if self._eigen:
             qa, qg, da, dg, dgda = {}, {}, {}, {}, {}
-            for b in self.buckets:
-                q_a, d_a = self._sharded_eigh(state.a[b.key])
-                q_g, d_g = self._sharded_eigh(state.g[b.key])
-                # Reshard to the strategy's resident layout: XLA inserts the
-                # KAISA inverse "broadcast" (all-gather over gw, or over the
-                # world for COMM-OPT) here.
-                qa[b.key] = jax.lax.with_sharding_constraint(q_a.astype(cfg.inv_dtype), dec)
-                qg[b.key] = jax.lax.with_sharding_constraint(q_g.astype(cfg.inv_dtype), dec)
-                if self._prediv:
+            # Reshard to the strategy's resident layout: XLA inserts the
+            # KAISA inverse "broadcast" (all-gather over gw, or over the
+            # world for COMM-OPT) at these constraints. With
+            # colocate_factors=False the A and G loops run over different
+            # stacks — a layer's two eigendecompositions land on whichever
+            # devices own their side's slots.
+            d_a_by_key, d_g_by_key = {}, {}
+            for sb in self.a_store:
+                q_a, d_a = self._sharded_eigh(state.a[sb.key])
+                qa[sb.key] = jax.lax.with_sharding_constraint(
+                    q_a.astype(cfg.inv_dtype), dec
+                )
+                d_a_by_key[sb.key] = d_a
+                if not self._prediv:
+                    da[sb.key] = jax.lax.with_sharding_constraint(
+                        d_a.astype(cfg.inv_dtype), dec
+                    )
+            for sb in self.g_store:
+                q_g, d_g = self._sharded_eigh(state.g[sb.key])
+                qg[sb.key] = jax.lax.with_sharding_constraint(
+                    q_g.astype(cfg.inv_dtype), dec
+                )
+                d_g_by_key[sb.key] = d_g
+                if not self._prediv:
+                    dg[sb.key] = jax.lax.with_sharding_constraint(
+                        d_g.astype(cfg.inv_dtype), dec
+                    )
+            if self._prediv:
+                # colocate-only (enforced in __post_init__): side keys are
+                # the pair-bucket keys, so eigenvalue stacks align by slot
+                for b in self.buckets:
                     fused = jax.vmap(
                         lambda da_, dg_: factors_lib.prediv_eigenvalues(
                             factors_lib.EigenDecomp(q=None, d=da_),
                             factors_lib.EigenDecomp(q=None, d=dg_),
                             damping,
                         )
-                    )(d_a, d_g)
+                    )(d_a_by_key[b.key], d_g_by_key[b.key])
                     dgda[b.key] = jax.lax.with_sharding_constraint(
                         fused.astype(cfg.inv_dtype), dec
                     )
-                else:
-                    da[b.key] = jax.lax.with_sharding_constraint(d_a.astype(cfg.inv_dtype), dec)
-                    dg[b.key] = jax.lax.with_sharding_constraint(d_g.astype(cfg.inv_dtype), dec)
             return state._replace(qa=qa, qg=qg, da=da, dg=dg, dgda=dgda)
         a_inv, g_inv = {}, {}
-        for b in self.buckets:
-            a_inv[b.key] = jax.lax.with_sharding_constraint(
-                self._sharded_inv(state.a[b.key], damping).astype(cfg.inv_dtype), dec
+        for sb in self.a_store:
+            a_inv[sb.key] = jax.lax.with_sharding_constraint(
+                self._sharded_inv(state.a[sb.key], damping).astype(cfg.inv_dtype), dec
             )
-            g_inv[b.key] = jax.lax.with_sharding_constraint(
-                self._sharded_inv(state.g[b.key], damping).astype(cfg.inv_dtype), dec
+        for sb in self.g_store:
+            g_inv[sb.key] = jax.lax.with_sharding_constraint(
+                self._sharded_inv(state.g[sb.key], damping).astype(cfg.inv_dtype), dec
             )
         return state._replace(a_inv=a_inv, g_inv=g_inv)
 
@@ -396,6 +536,30 @@ class DistributedKFAC:
                 rows += [jnp.zeros((b.dg, b.da), rows[0].dtype)] * pad
             gstack = jnp.stack(rows).astype(cfg.inv_dtype)
             gstack = jax.lax.with_sharding_constraint(gstack, dec)
+
+            def asm(side_dict, slot_map, row_shape):
+                """Assemble this pair bucket's decomp stack from side slots.
+
+                Colocated: side keys are pair keys and slots align — use the
+                resident stack as-is (no extra collective). Non-colocated:
+                gather each layer's row from its side stack and replicate
+                the assembly — the decomposition exchange non-colocation
+                buys its eigh parallelism with (the reference ships inverses
+                to grad workers the same way, kfac/assignment.py:268-304).
+                """
+                if self.colocate:
+                    return side_dict[b.key]
+                rws = [
+                    jax.lax.with_sharding_constraint(
+                        side_dict[slot_map[n][0]][slot_map[n][1]], rep
+                    )
+                    for n in b.layers
+                ]
+                pad_n = b.padded - len(b.layers)
+                if pad_n:
+                    rws += [jnp.zeros(row_shape, rws[0].dtype)] * pad_n
+                return jax.lax.with_sharding_constraint(jnp.stack(rws), rep)
+
             if self._prediv:
                 def prec_fused(gm, qa_, qg_, fused_):
                     v1 = qg_.T @ gm @ qa_
@@ -406,8 +570,10 @@ class DistributedKFAC:
                     state.dgda[b.key],
                 )
             elif self._eigen:
-                qa, qg = state.qa[b.key], state.qg[b.key]
-                dada, dgdg = state.da[b.key], state.dg[b.key]
+                qa = asm(state.qa, self._a_slot, (b.da, b.da))
+                qg = asm(state.qg, self._g_slot, (b.dg, b.dg))
+                dada = asm(state.da, self._a_slot, (b.da,))
+                dgdg = asm(state.dg, self._g_slot, (b.dg,))
 
                 def prec(gm, qa_, qg_, da_, dg_):
                     v1 = qg_.T @ gm @ qa_
@@ -417,7 +583,9 @@ class DistributedKFAC:
                 pstack = jax.vmap(prec)(gstack, qa, qg, dada, dgdg)
             else:
                 pstack = jax.vmap(lambda gm, ai, gi: gi @ gm @ ai)(
-                    gstack, state.a_inv[b.key], state.g_inv[b.key]
+                    gstack,
+                    asm(state.a_inv, self._a_slot, (b.da, b.da)),
+                    asm(state.g_inv, self._g_slot, (b.dg, b.dg)),
                 )
             if cfg.kl_clip is not None:
                 vg = vg + jnp.sum(

@@ -106,6 +106,22 @@ class KFACPreconditioner:
     prediv_eigenvalues: bool = False
     factor_dtype: Any = jnp.float32
     inv_dtype: Any = jnp.float32
+    # Whether the distributed engine stores/decomposes a layer's A and G in
+    # the same stack slot (same device). False buckets A and G factors
+    # independently by dimension, so the two eigendecompositions of a large
+    # layer can run on different devices — the reference's
+    # colocate_factors=False placement split (kfac/assignment.py:268-304) —
+    # at the cost of replicating the assembled decompositions for
+    # preconditioning. Ignored by the dense engine.
+    colocate_factors: bool = True
+    # How the distributed engine transports factor statistics into the
+    # stacked layout: ALLREDUCE gathers each factor individually (XLA fuses
+    # on ICI); ALLREDUCE_BUCKETED packs all upper triangles of a bucket into
+    # one flat buffer first — fewer, larger collectives and half the bytes,
+    # the reference's symmetric 25MB bucketing (kfac/distributed.py:305-374,
+    # 422-465) for DCN-bound multihost meshes. Ignored by the dense engine
+    # (no transport).
+    allreduce_method: enums.AllreduceMethod = enums.AllreduceMethod.ALLREDUCE
 
     def __post_init__(self) -> None:
         if isinstance(self.compute_method, str):
@@ -115,6 +131,17 @@ class KFACPreconditioner:
                 raise ValueError(
                     f'unknown compute_method {self.compute_method!r}; '
                     f'expected one of {[m.name.lower() for m in enums.ComputeMethod]}'
+                ) from None
+        if isinstance(self.allreduce_method, str):
+            try:
+                self.allreduce_method = enums.AllreduceMethod[
+                    self.allreduce_method.upper()
+                ]
+            except KeyError:
+                raise ValueError(
+                    f'unknown allreduce_method {self.allreduce_method!r}; '
+                    f'expected one of '
+                    f'{[m.name.lower() for m in enums.AllreduceMethod]}'
                 ) from None
         for name in ('factor_update_steps', 'inv_update_steps'):
             value = getattr(self, name)

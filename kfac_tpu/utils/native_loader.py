@@ -57,6 +57,14 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
         ]
+        lib.loader_create_aug.restype = ctypes.c_void_p
+        lib.loader_create_aug.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int64,
+        ]
         lib.loader_next.restype = ctypes.c_int64
         lib.loader_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
         lib.loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
@@ -71,12 +79,20 @@ class PrefetchLoader:
     """Iterate shuffled batches assembled by the native worker thread.
 
     Args:
-        data: (n, ...) float32 array (may be memory-mapped).
+        data: (n, ...) float32 array. May be memory-mapped (e.g.
+            ``np.load(..., mmap_mode='r')``): if it is already C-contiguous
+            float32, no copy is made and the C++ worker reads the mapped
+            pages directly — the on-disk ImageNet-style layout.
         labels: (n,) int32 array.
         batch_size: samples per batch.
         n_ring: prefetch depth (ring buffer slots).
         seed: shuffle seed.
         drop_last: drop the final ragged batch each epoch.
+        augment: optional dict enabling in-worker image augmentation for
+            (H, W, C) samples: ``{'pad': 4, 'flip': True}`` applies the
+            reference CIFAR pipeline (RandomCrop(padding=pad) +
+            RandomHorizontalFlip, examples/vision/datasets.py) on the host
+            thread, overlapped with device compute.
     """
 
     def __init__(
@@ -87,6 +103,8 @@ class PrefetchLoader:
         n_ring: int = 3,
         seed: int = 0,
         drop_last: bool = True,
+        augment: dict | None = None,
+        start_epoch: int = 0,
     ) -> None:
         lib = _load_lib()
         self._lib = lib
@@ -105,16 +123,26 @@ class PrefetchLoader:
             (n_ring, batch_size, sample_elems), dtype=np.float32
         )
         self._ring_labels = np.empty((n_ring, batch_size), dtype=np.int32)
-        self._handle = lib.loader_create(
+        if augment is not None and len(self.sample_shape) != 3:
+            raise ValueError(
+                f'augment needs (H, W, C) samples, got {self.sample_shape}'
+            )
+        h, w, c = self.sample_shape if augment is not None else (0, 0, 0)
+        self._handle = lib.loader_create_aug(
             self.data.ctypes.data_as(ctypes.c_void_p),
             self.labels.ctypes.data_as(ctypes.c_void_p),
             n, sample_elems, batch_size, n_ring,
             self._ring_data.ctypes.data_as(ctypes.c_void_p),
             self._ring_labels.ctypes.data_as(ctypes.c_void_p),
             seed, int(drop_last),
+            h, w, c,
+            int(augment.get('pad', 4)) if augment is not None else 0,
+            int(bool(augment.get('flip', True))) if augment is not None else 0,
+            int(start_epoch),
         )
         self.batches_per_epoch = int(lib.loader_batches_per_epoch(self._handle))
-        self._next_epoch = 0  # epoch the next epoch_batches() call serves
+        # epoch the next epoch_batches() call serves (start_epoch on resume)
+        self._next_epoch = int(start_epoch)
 
     def __iter__(self):
         return self.epoch_batches()

@@ -42,6 +42,12 @@ struct Loader {
   int32_t* batch_labels;     // ring: (n_ring, batch_size)
   uint64_t seed;
   bool drop_last;
+  // image augmentation (HWC layout); aug_h == 0 disables
+  int64_t aug_h = 0, aug_w = 0, aug_c = 0, aug_pad = 0;
+  bool aug_flip = false;
+  // resume support: fast-forward the shuffle stream to this epoch so a
+  // resumed run sees the same batch order the uninterrupted run would
+  int64_t start_epoch = 0;
 
   std::vector<std::thread> workers;
   std::mutex mu;
@@ -58,12 +64,65 @@ struct Loader {
   }
 };
 
+// Random pad-crop + horizontal flip of one HWC image (the reference's
+// RandomCrop(32, padding=4) + RandomHorizontalFlip pipeline,
+// examples/vision/datasets.py). dy/dx are crop offsets into the
+// zero-padded image: out(y, x) = in(y + dy - pad, x' + dx - pad) with
+// x' mirrored when flipping; out-of-bounds source pixels are zero.
+void augment_sample(const Loader* L, const float* src, float* dst,
+                    int64_t dy, int64_t dx, bool flip) {
+  const int64_t H = L->aug_h, W = L->aug_w, C = L->aug_c, P = L->aug_pad;
+  for (int64_t y = 0; y < H; ++y) {
+    float* drow = dst + y * W * C;
+    const int64_t sy = y + dy - P;
+    if (sy < 0 || sy >= H) {
+      std::memset(drow, 0, sizeof(float) * W * C);
+      continue;
+    }
+    const float* srow = src + sy * W * C;
+    if (!flip) {
+      // contiguous run of in-bounds source columns
+      for (int64_t x = 0; x < W; ++x) {
+        const int64_t sx = x + dx - P;
+        if (sx < 0 || sx >= W) {
+          std::memset(drow + x * C, 0, sizeof(float) * C);
+        } else {
+          std::memcpy(drow + x * C, srow + sx * C, sizeof(float) * C);
+        }
+      }
+    } else {
+      for (int64_t x = 0; x < W; ++x) {
+        const int64_t sx = x + dx - P;
+        if (sx < 0 || sx >= W) {
+          std::memset(drow + x * C, 0, sizeof(float) * C);
+        } else {
+          std::memcpy(drow + x * C, srow + (W - 1 - sx) * C,
+                      sizeof(float) * C);
+        }
+      }
+    }
+  }
+}
+
 void producer_loop(Loader* L) {
   std::mt19937_64 rng(L->seed);
   std::vector<int64_t> order(L->n);
   for (int64_t i = 0; i < L->n; ++i) order[i] = i;
   if (L->batches_per_epoch() == 0) return;  // nothing to produce; don't spin
-  int64_t epoch = 0;
+  // advance the shuffle (and augmentation) stream past completed epochs
+  for (int64_t e = 0; e < L->start_epoch; ++e) {
+    std::shuffle(order.begin(), order.end(), rng);
+    if (L->aug_h > 0) {
+      std::uniform_int_distribution<int64_t> off(0, 2 * L->aug_pad);
+      std::uniform_int_distribution<int> coin(0, 1);
+      const int64_t nb = L->batches_per_epoch();
+      for (int64_t i = 0; i < nb * L->batch_size; ++i) {
+        off(rng); off(rng);
+        if (L->aug_flip) coin(rng);
+      }
+    }
+  }
+  int64_t epoch = L->start_epoch;
   while (!L->stop.load()) {
     std::shuffle(order.begin(), order.end(), rng);
     const int64_t nb = L->batches_per_epoch();
@@ -80,12 +139,21 @@ void producer_loop(Loader* L) {
       }
       float* out = L->batch_data + slot * L->batch_size * L->sample_elems;
       int32_t* lab = L->batch_labels + slot * L->batch_size;
+      const bool aug = L->aug_h > 0;
+      std::uniform_int_distribution<int64_t> off(0, 2 * L->aug_pad);
+      std::uniform_int_distribution<int> coin(0, 1);
       for (int64_t j = 0; j < L->batch_size; ++j) {
         // wrap for the final ragged batch when drop_last is false
         int64_t idx = order[(b * L->batch_size + j) % L->n];
-        std::memcpy(out + j * L->sample_elems,
-                    L->data + idx * L->sample_elems,
-                    sizeof(float) * L->sample_elems);
+        const float* src = L->data + idx * L->sample_elems;
+        float* dst = out + j * L->sample_elems;
+        if (aug) {
+          const int64_t dy = off(rng), dx = off(rng);
+          const bool flip = L->aug_flip && coin(rng) == 1;
+          augment_sample(L, src, dst, dy, dx, flip);
+        } else {
+          std::memcpy(dst, src, sizeof(float) * L->sample_elems);
+        }
         lab[j] = L->labels[idx];
       }
       {
@@ -102,10 +170,15 @@ void producer_loop(Loader* L) {
 
 extern "C" {
 
-void* loader_create(const float* data, const int32_t* labels, int64_t n,
-                    int64_t sample_elems, int64_t batch_size, int64_t n_ring,
-                    float* batch_data, int32_t* batch_labels, uint64_t seed,
-                    int drop_last) {
+// Full-featured constructor: random pad-crop (+/- pad pixels) + optional
+// horizontal flip per sample when h > 0 (HWC images; h*w*c == sample_elems),
+// and shuffle-stream fast-forward to start_epoch for resumed runs.
+void* loader_create_aug(const float* data, const int32_t* labels, int64_t n,
+                        int64_t sample_elems, int64_t batch_size,
+                        int64_t n_ring, float* batch_data,
+                        int32_t* batch_labels, uint64_t seed, int drop_last,
+                        int64_t h, int64_t w, int64_t c, int64_t pad,
+                        int flip, int64_t start_epoch) {
   auto* L = new Loader();
   L->data = data;
   L->labels = labels;
@@ -117,9 +190,25 @@ void* loader_create(const float* data, const int32_t* labels, int64_t n,
   L->batch_labels = batch_labels;
   L->seed = seed;
   L->drop_last = drop_last != 0;
+  L->aug_h = h;
+  L->aug_w = w;
+  L->aug_c = c;
+  L->aug_pad = pad;
+  L->aug_flip = flip != 0;
+  L->start_epoch = start_epoch;
   for (int64_t s = 0; s < n_ring; ++s) L->free_slots.push_back(s);
   L->workers.emplace_back(producer_loop, L);
   return L;
+}
+
+void* loader_create(const float* data, const int32_t* labels, int64_t n,
+                    int64_t sample_elems, int64_t batch_size, int64_t n_ring,
+                    float* batch_data, int32_t* batch_labels, uint64_t seed,
+                    int drop_last) {
+  return loader_create_aug(data, labels, n, sample_elems, batch_size, n_ring,
+                           batch_data, batch_labels, seed, drop_last,
+                           /*h=*/0, /*w=*/0, /*c=*/0, /*pad=*/0, /*flip=*/0,
+                           /*start_epoch=*/0);
 }
 
 // Blocks until a batch is ready; returns its ring index and writes the

@@ -82,3 +82,19 @@ def test_get_cov_dispatches_to_pallas(monkeypatch):
         )
     )(a_sharded) / 64
     np.testing.assert_allclose(np.asarray(out_sm), ref, rtol=1e-5, atol=1e-4)
+
+
+def test_sym_cov_spmd_replicated_and_feature_sharded():
+    """Edge shardings the partition callback must handle: fully replicated
+    (rank-0 PartitionSpec) and feature-sharded (gathered, never propagated
+    into C's dims)."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ('x',))
+    a = jax.random.normal(jax.random.PRNGKey(2), (96, 40))
+    ref = np.asarray(a).T @ np.asarray(a)
+    for spec in (P(), P(None, 'x')):
+        a_s = jax.device_put(a, NamedSharding(mesh, spec))
+        out = jax.jit(pallas_cov.sym_cov_spmd)(a_s)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-4)

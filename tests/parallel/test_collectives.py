@@ -1,75 +1,14 @@
-"""Collective wrapper and transport utility tests (8-device CPU mesh).
+"""Symmetric/bucketed transport utility tests.
 
 Behavioral targets from reference tests/distributed_test.py:51-313
-(allreduce/broadcast/symmetric transport), restated for mesh collectives.
+(symmetric/bucketed transport). The thin collective wrappers were removed:
+XLA collectives are used directly where needed.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
 
 from kfac_tpu.parallel import collectives
-
-
-def _mesh1d():
-    return Mesh(np.asarray(jax.devices()).reshape(8), ('x',))
-
-
-def test_psum_mean():
-    mesh = _mesh1d()
-
-    def body(x):
-        return collectives.psum_mean(x, 'x')
-
-    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
-    out = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P('x'), out_specs=P('x'))
-    )(x)
-    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.5))
-
-
-def test_broadcast_from_src():
-    mesh = _mesh1d()
-
-    def body(x):
-        return collectives.broadcast_from(x, 'x', src_index=3)
-
-    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
-    out = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P('x'), out_specs=P('x'))
-    )(x)
-    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.0))
-
-
-def test_all_gather_axis():
-    mesh = _mesh1d()
-
-    def body(x):
-        return collectives.all_gather_axis(x, 'x', axis=0)
-
-    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
-    out = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P('x'), out_specs=P(None, 'x'))
-    )(x)
-    assert out.shape == (8, 8)
-    np.testing.assert_allclose(np.asarray(out)[:, 0], np.arange(8))
-
-
-def test_reduce_scatter():
-    mesh = _mesh1d()
-
-    def body(x):
-        # local view is (1, 8); scatter the 8-wide dim across the axis
-        return collectives.reduce_scatter_axis(x, 'x', axis=1)
-
-    x = jnp.ones((8, 8), dtype=jnp.float32)
-    out = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P('x'), out_specs=P('x'))
-    )(x)
-    # row i of the result is the sum over devices of their column-i slice
-    assert out.shape == (8, 1)
-    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 8.0))
 
 
 def test_triu_roundtrip():

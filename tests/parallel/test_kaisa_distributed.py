@@ -247,3 +247,122 @@ def test_prediv_memory_accounted():
         b.padded * b.dg * b.da * 4 for b in dk.buckets
     ) / mesh_lib.n_cols(mesh)
     assert usage['g_inverses'] >= expected_dgda
+
+
+def test_bucketed_allreduce_matches_default():
+    """ALLREDUCE_BUCKETED (triangle-packed single-buffer stat transport)
+    must be numerically identical to the per-factor default — engaging the
+    reference's symmetric bucketing (kfac/distributed.py:305-374,422-465)."""
+
+    def run(method):
+        mesh, m, params, batch, reg, cfg, dk, loss_fn = _setup(
+            0.5, kl_clip=0.001, damping=0.01,
+            factor_update_steps=1, inv_update_steps=1,
+            allreduce_method=method,
+        )
+        cap = kfac_tpu.CurvatureCapture(reg)
+        runner = cap.value_stats_and_grad(loss_fn)
+        state = dk.init()
+
+        @jax.jit
+        def step(params, state, batch):
+            (l, _), grads, stats = runner(params, batch)
+            state, pg = dk.step(state, grads, stats)
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - 0.05 * g, params, pg
+            )
+            return params, state, l
+
+        bs = batch_sharding(mesh)
+        batch = tuple(jax.device_put(b, bs) for b in batch)
+        losses = []
+        for _ in range(4):
+            params, state, l = step(params, state, batch)
+            losses.append(float(l))
+        return losses, params
+
+    l_def, p_def = run('allreduce')
+    l_b, p_b = run('allreduce_bucketed')
+    np.testing.assert_allclose(l_b, l_def, rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p_def), jax.tree_util.tree_leaves(p_b)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
+
+
+@pytest.mark.parametrize('method', ['eigen', 'inverse'])
+def test_colocate_factors_false_placement_and_numerics(method):
+    """colocate_factors=False stores A and G in independent dimension
+    buckets (different placement: one layer's factors in different
+    stacks/slots, reference kfac/assignment.py:268-304) while the
+    preconditioned gradients stay numerically identical to the dense
+    engine."""
+    import flax.linen as nn
+
+    class Wide(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(16, name='p')(x))
+            x = nn.relu(nn.Dense(16, name='q')(x))
+            return nn.Dense(4, name='r')(x)
+
+    m = Wide()
+    x = jax.random.normal(jax.random.PRNGKey(0), (WORLD * 4, 16))
+    y = jax.random.normal(jax.random.PRNGKey(1), (WORLD * 4, 4))
+    params = m.init(jax.random.PRNGKey(2), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        return jnp.mean((m.apply({'params': params}, xb) - yb) ** 2)
+
+    mesh = kaisa_mesh(grad_worker_fraction=0.5)
+    cfg = kfac_tpu.KFACPreconditioner(
+        registry=reg, compute_method=method, damping=0.01, kl_clip=0.001,
+        colocate_factors=False,
+    )
+    dk = DistributedKFAC(config=cfg, mesh=mesh)
+
+    # placement: A side groups all three layers (shared da=17) in ONE
+    # stack while G splits 16s from 4s — slots no longer pairwise aligned
+    assert [sb.key for sb in dk.a_store] == ['a17']
+    assert sorted(sb.key for sb in dk.g_store) == ['g16', 'g4']
+    assert dk._a_slot['r'] == ('a17', 2)
+    assert dk._g_slot['r'] == ('g4', 0)
+    assert not dk.assignment.colocate_factors
+
+    cap = kfac_tpu.CurvatureCapture(reg)
+    (_, _), grads, stats = cap.value_stats_and_grad(loss_fn)(params, (x, y))
+
+    ref_cfg = kfac_tpu.KFACPreconditioner(
+        registry=reg, compute_method=method, damping=0.01, kl_clip=0.001,
+    )
+    ref_state, ref_grads = ref_cfg.step(ref_cfg.init(), grads, stats)
+
+    state = dk.init()
+    assert set(state.a) == {'a17'}
+    assert set(state.g) == {'g16', 'g4'}
+
+    @jax.jit
+    def dstep(state, grads, stats):
+        return dk.step(state, grads, stats)
+
+    state, dist_grads = dstep(state, grads, stats)
+    for name in reg.names():
+        np.testing.assert_allclose(
+            np.asarray(dist_grads[name]['kernel']),
+            np.asarray(ref_grads[name]['kernel']),
+            rtol=5e-3, atol=1e-5,
+        )
+
+
+def test_mem_opt_requires_colocated():
+    mesh = kaisa_mesh(grad_worker_fraction=1 / WORLD)
+    m = models.TinyModel(hidden=8, out=4)
+    x, _ = models.regression_data(jax.random.PRNGKey(1), n=8, dim=6)
+    reg = kfac_tpu.register_model(m, x)
+    cfg = kfac_tpu.KFACPreconditioner(registry=reg, colocate_factors=False)
+    with pytest.raises(ValueError, match='MEM-OPT'):
+        DistributedKFAC(config=cfg, mesh=mesh)

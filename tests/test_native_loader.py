@@ -89,3 +89,44 @@ def test_early_break_resyncs_next_epoch(loader_cls):
     assert len(e1) == ldr.batches_per_epoch
     assert sorted(np.concatenate(e1).tolist()) == list(range(n))
     ldr.close()
+
+
+def test_augmented_batches_are_crops_and_flips(loader_cls):
+    """In-worker augmentation: every emitted sample is a zero-padded random
+    crop (optionally flipped) of its source image — nonzero pixels must all
+    come from the source, and augmentation must actually perturb samples."""
+    n, h, w, c = 32, 8, 8, 3
+    data = np.random.default_rng(0).normal(size=(n, h, w, c)).astype(np.float32)
+    labels = np.arange(n, dtype=np.int32)
+    ldr = loader_cls(
+        data, labels, batch_size=8, seed=5, augment={'pad': 2, 'flip': True}
+    )
+    differing = 0
+    for x, y in ldr.epoch_batches():
+        assert x.shape == (8, h, w, c)
+        for xi, yi in zip(x, y):
+            orig = data[yi]
+            if not np.array_equal(xi, orig):
+                differing += 1
+            vals = set(np.round(xi[xi != 0], 5).ravel().tolist())
+            ovals = set(np.round(orig, 5).ravel().tolist())
+            assert vals <= ovals
+    ldr.close()
+    assert differing > n // 2
+
+
+def test_start_epoch_fast_forwards_shuffle(loader_cls):
+    """A loader created with start_epoch=k must emit exactly the batches a
+    fresh loader emits for its (k+1)-th epoch — the resume contract."""
+    n, bs = 48, 8
+    data = np.zeros((n, 2), dtype=np.float32)
+    labels = np.arange(n, dtype=np.int32)
+    fresh = loader_cls(data, labels, batch_size=bs, seed=11)
+    _ = [y for _, y in fresh.epoch_batches()]        # epoch 0
+    want = [y for _, y in fresh.epoch_batches()]     # epoch 1
+    fresh.close()
+    resumed = loader_cls(data, labels, batch_size=bs, seed=11, start_epoch=1)
+    got = [y for _, y in resumed.epoch_batches()]
+    resumed.close()
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
