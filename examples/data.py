@@ -39,19 +39,24 @@ def cifar_on_disk(data_dir: str | None) -> bool:
     )
 
 
+def _imagenet_memmap_files(data_dir: str) -> list[str]:
+    """The on-disk memmap layout (single source of truth for the detector
+    and the loader)."""
+    return [
+        os.path.join(data_dir, f'imagenet_{k}_{s}.npy')
+        for k in ('x', 'y')
+        for s in ('train', 'test')
+    ]
+
+
 def imagenet_on_disk(data_dir: str | None) -> bool:
     """Whether :func:`imagenet_like` would load real data (memmap .npy
     layout needs all four files, else the .npz)."""
     if not data_dir:
         return False
-    mm = [
-        os.path.join(data_dir, f'imagenet_{k}_{s}.npy')
-        for k in ('x', 'y')
-        for s in ('train', 'test')
-    ]
-    return all(os.path.exists(f) for f in mm) or os.path.exists(
-        os.path.join(data_dir, 'imagenet.npz')
-    )
+    return all(
+        os.path.exists(f) for f in _imagenet_memmap_files(data_dir)
+    ) or os.path.exists(os.path.join(data_dir, 'imagenet.npz'))
 
 
 def cifar10(data_dir: str | None = None, n_train: int = 50000, n_test: int = 10000):
@@ -86,12 +91,7 @@ def imagenet_like(
     level. Falls back to ``imagenet.npz`` (loaded into RAM), then synthetic.
     """
     if data_dir:
-        mm_files = [
-            os.path.join(data_dir, f'imagenet_{k}_{s}.npy')
-            for k in ('x', 'y')
-            for s in ('train', 'test')
-        ]
-        if all(os.path.exists(f) for f in mm_files):
+        if all(os.path.exists(f) for f in _imagenet_memmap_files(data_dir)):
             def load(split):
                 x = np.load(
                     os.path.join(data_dir, f'imagenet_x_{split}.npy'),

@@ -166,6 +166,112 @@ class Trainer:
         self._step_count += 1
         return out
 
+    # ------------------------------------------------------- compiled loops
+
+    def _executed_layers(self, state: TrainState, batch) -> set[str]:
+        """Registered layers that this loss_fn actually executes.
+
+        Discovered once by abstractly tracing the capture (eval_shape, no
+        FLOPs). The zero-stats template must cover exactly this subset:
+        covering ALL registry layers would (a) make the two cadence-cond
+        branches structurally different and (b) feed zero statistics into
+        the factor EMA for unexecuted layers, decaying their factors toward
+        zero instead of leaving them untouched (the engines treat
+        stats-absent layers as "keep current value").
+        """
+        if not hasattr(self, '_executed'):
+            out = jax.eval_shape(
+                self._run_stats, state.params, (state.model_state, batch)
+            )
+            self._executed = set(out[2].a.keys())
+        return self._executed
+
+    def _zero_stats(self, executed: set[str]):
+        """Stats-shaped zeros for the no-capture branch of a device-side
+        cadence cond (ignored downstream: kfac.step's own cond skips the
+        factor EMA on exactly the same steps)."""
+        reg = self.registry
+        return capture_lib.CapturedStats(
+            a={
+                n: jax.numpy.zeros(h.a_factor_shape, h.factor_dtype)
+                for n, h in reg.layers.items()
+                if n in executed
+            },
+            g={
+                n: jax.numpy.zeros(h.g_factor_shape, h.factor_dtype)
+                for n, h in reg.layers.items()
+                if n in executed
+            },
+        )
+
+    def _scan_body(self, state: TrainState, batch, executed: set[str]):
+        """One train step with DEVICE-side cadence dispatch (lax.cond picks
+        the capture branch, XLA executes only the taken one), so the whole
+        loop compiles into a single lax.scan — no per-step host round trip.
+        """
+        if self.kfac is None:
+            return self._step_no_stats(state, batch)
+        kstate = state.kfac_state
+        cadence = self.factor_update_steps
+        if callable(cadence):
+            cadence = jax.numpy.maximum(1, cadence(kstate.step))
+        capture_now = kstate.step % cadence == 0
+
+        def with_cap(_):
+            (loss, new_ms), grads, stats = self._run_stats(
+                state.params, (state.model_state, batch)
+            )
+            return loss, new_ms, grads, stats
+
+        def no_cap(_):
+            (loss, new_ms), grads = jax.value_and_grad(
+                self.loss_fn, has_aux=True
+            )(state.params, state.model_state, batch)
+            return loss, new_ms, grads, self._zero_stats(executed)
+
+        loss, new_ms, grads, stats = jax.lax.cond(
+            capture_now, with_cap, no_cap, None
+        )
+        kstate, grads = self.kfac.step(kstate, grads, stats)
+        params, opt_state, model_state = self._apply_update(
+            state, grads, new_ms
+        )
+        return TrainState(params, opt_state, kstate, model_state), loss
+
+    def scan_steps(
+        self, state: TrainState, batches
+    ) -> tuple[TrainState, jax.Array]:
+        """Run ``len(batches)`` steps as ONE compiled ``lax.scan``.
+
+        ``batches`` is a pytree with a leading steps axis. The eager
+        :meth:`step` dispatches the capture variant host-side (two jitted
+        programs); here the cadence cond lives on device so the loop can sit
+        inside profiled/compiled outer loops — the XLA equivalent of the
+        reference's hook-driven epoch loop with no Python in the hot path.
+        Returns (final_state, per-step losses).
+        """
+        if not hasattr(self, '_jit_scan'):
+            donate = (0,) if self.donate_state else ()
+            executed = (
+                self._executed_layers(
+                    state, jax.tree_util.tree_map(lambda b: b[0], batches)
+                )
+                if self.kfac is not None
+                else set()
+            )
+
+            def run(state, batches):
+                return jax.lax.scan(
+                    lambda s, b: self._scan_body(s, b, executed),
+                    state,
+                    batches,
+                )
+
+            self._jit_scan = jax.jit(run, donate_argnums=donate)
+        state, losses = self._jit_scan(state, batches)
+        self._step_count = None  # host mirror resyncs from the device step
+        return state, losses
+
     # --------------------------------------------------------- accumulation
 
     def _grads_and_stats(self, params, model_state, batch):
@@ -229,6 +335,85 @@ class Trainer:
         )
         self._step_count += 1
         return new_state, loss_acc / n
+
+    def step_accumulate_scan(
+        self, state: TrainState, microbatches
+    ) -> tuple[TrainState, jax.Array]:
+        """:meth:`step_accumulate` with the micro-batch loop compiled.
+
+        ``microbatches`` is a pytree with a leading micro-batch axis; the
+        accumulation runs as a ``lax.scan`` inside ONE jitted program
+        (the eager variant dispatches one jit call per micro-batch — pure
+        Python-loop overhead on small models).
+        """
+        if self.kfac is None:
+            raise ValueError(
+                'step_accumulate_scan requires a kfac preconditioner'
+            )
+        self._sync_step_count(state)
+        capture_now = self._capture_now()
+        if not hasattr(self, '_jit_accum_scan'):
+            executed = self._executed_layers(
+                state, jax.tree_util.tree_map(lambda b: b[0], microbatches)
+            )
+
+            def accum(state, mbs, with_stats):
+                n = jax.tree_util.tree_leaves(mbs)[0].shape[0]
+
+                def body(carry, mb):
+                    model_state, loss_acc, grads_acc, stats_acc = carry
+                    if with_stats:
+                        (loss, new_ms), grads, stats = self._run_stats(
+                            state.params, (model_state, mb)
+                        )
+                        stats_acc = capture_lib.accumulate_stats(
+                            stats_acc, stats
+                        )
+                    else:
+                        (loss, new_ms), grads = jax.value_and_grad(
+                            self.loss_fn, has_aux=True
+                        )(state.params, model_state, mb)
+                    grads_acc = jax.tree_util.tree_map(
+                        jnp_add, grads_acc, grads
+                    )
+                    return (new_ms, loss_acc + loss, grads_acc, stats_acc), None
+
+                zero_grads = jax.tree_util.tree_map(
+                    jax.numpy.zeros_like, state.params
+                )
+                carry0 = (
+                    state.model_state,
+                    jax.numpy.zeros((), jax.numpy.float32),
+                    zero_grads,
+                    self._zero_stats(executed),
+                )
+                (model_state, loss_sum, grads_sum, stats_sum), _ = (
+                    jax.lax.scan(body, carry0, mbs)
+                )
+                grads_avg = jax.tree_util.tree_map(
+                    lambda g: g / n, grads_sum
+                )
+                stats_avg = (
+                    capture_lib.average_stats(stats_sum, n)
+                    if with_stats
+                    else None
+                )
+                kstate, grads = self.kfac.step(
+                    state.kfac_state, grads_avg, stats_avg
+                )
+                params, opt_state, new_ms = self._apply_update(
+                    state, grads, model_state
+                )
+                return TrainState(params, opt_state, kstate, new_ms), (
+                    loss_sum / n
+                )
+
+            self._jit_accum_scan = jax.jit(
+                accum, static_argnames=('with_stats',)
+            )
+        out = self._jit_accum_scan(state, microbatches, with_stats=capture_now)
+        self._step_count += 1
+        return out
 
     def _apply_accumulated(self, state: TrainState, grads, stats, with_stats):
         kfac_state, grads = self.kfac.step(

@@ -235,3 +235,165 @@ def test_trainer_resumes_cadence_from_restored_state():
     # steps 4,5,6 ran; the device cadence captured at step 6 — factors moved
     assert int(state.kfac_state.step) == 7
     assert float(jnp.abs(state.kfac_state.a['dense0'] - a_before).max()) > 0
+
+
+def test_scan_steps_matches_eager_loop():
+    """The single-compiled lax.scan loop (device-side cadence cond) must
+    produce the same trajectory as the host-dispatched eager step loop."""
+    m = MLP(features=(16,), num_classes=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+    y = jax.nn.one_hot(jnp.arange(16) % 4, 4)
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+
+    def loss_fn(params, model_state, batch):
+        xx, yy = batch
+        logits = m.apply({'params': params}, xx)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * yy, -1)), model_state
+
+    def make():
+        kfac = kfac_tpu.KFACPreconditioner(
+            registry=reg, factor_update_steps=3, inv_update_steps=3,
+            damping=0.01,
+        )
+        return training.Trainer(
+            loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=kfac
+        )
+
+    n_steps = 7
+    batches = (
+        jnp.broadcast_to(x, (n_steps,) + x.shape),
+        jnp.broadcast_to(y, (n_steps,) + y.shape),
+    )
+
+    t_eager = make()
+    s_eager = t_eager.init(params)
+    eager_losses = []
+    for i in range(n_steps):
+        s_eager, l = t_eager.step(s_eager, (x, y))
+        eager_losses.append(float(l))
+
+    t_scan = make()
+    s_scan, losses = t_scan.scan_steps(t_scan.init(params), batches)
+    np.testing.assert_allclose(
+        np.asarray(losses), eager_losses, rtol=1e-5, atol=1e-7
+    )
+    assert int(s_scan.kfac_state.step) == n_steps
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_eager.params),
+        jax.tree_util.tree_leaves(s_scan.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+    # factors followed the same cadence
+    np.testing.assert_allclose(
+        np.asarray(s_eager.kfac_state.a['dense0']),
+        np.asarray(s_scan.kfac_state.a['dense0']),
+        rtol=1e-5, atol=1e-6,
+    )
+    # the scan loop keeps working after a resume-style handoff to eager
+    s_scan, _ = t_scan.step(s_scan, (x, y))
+    assert int(s_scan.kfac_state.step) == n_steps + 1
+
+
+def test_step_accumulate_scan_matches_eager_accumulate():
+    m = MLP(features=(16,), num_classes=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (24, 8))
+    y = jax.nn.one_hot(jnp.arange(24) % 4, 4)
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    reg = kfac_tpu.register_model(m, x[:8])
+
+    def loss_fn(params, model_state, batch):
+        xx, yy = batch
+        logits = m.apply({'params': params}, xx)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * yy, -1)), model_state
+
+    def make():
+        kfac = kfac_tpu.KFACPreconditioner(
+            registry=reg, factor_update_steps=2, inv_update_steps=2,
+            damping=0.01,
+        )
+        return training.Trainer(
+            loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=kfac
+        )
+
+    mbs_list = [(x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8]) for i in range(3)]
+    mbs_stacked = (
+        jnp.stack([mb[0] for mb in mbs_list]),
+        jnp.stack([mb[1] for mb in mbs_list]),
+    )
+
+    t_e = make()
+    s_e = t_e.init(params)
+    for _ in range(3):  # cross both cadence phases
+        s_e, l_e = t_e.step_accumulate(s_e, mbs_list)
+
+    t_s = make()
+    s_s = t_s.init(params)
+    for _ in range(3):
+        s_s, l_s = t_s.step_accumulate_scan(s_s, mbs_stacked)
+
+    np.testing.assert_allclose(float(l_s), float(l_e), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_e.params),
+        jax.tree_util.tree_leaves(s_s.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_scan_steps_with_unexecuted_registered_layer():
+    """A registered module the loss_fn never executes must not break the
+    compiled loop, and its factors must stay untouched (engines treat
+    stats-absent layers as keep-current-value)."""
+    import flax.linen as nn
+
+    class TwoHeads(nn.Module):
+        @nn.compact
+        def __call__(self, x, use_aux=False):
+            h = nn.relu(nn.Dense(16, name='trunk')(x))
+            if use_aux:
+                return nn.Dense(4, name='aux_head')(h)
+            return nn.Dense(4, name='main_head')(h)
+
+    m = TwoHeads()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+    y = jax.nn.one_hot(jnp.arange(16) % 4, 4)
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    aux_p = m.init(jax.random.PRNGKey(1), x, use_aux=True)['params']
+    params['aux_head'] = aux_p['aux_head']
+    # register BOTH heads (probe executes aux), train only main
+    reg_aux = kfac_tpu.register_model(m, x, apply_fn=lambda xx: (
+        m.init(jax.random.PRNGKey(0), xx), m.init(jax.random.PRNGKey(0), xx, use_aux=True)
+    ))
+    assert 'aux_head' in reg_aux.layers and 'main_head' in reg_aux.layers
+
+    def loss_fn(params, model_state, batch):
+        xx, yy = batch
+        logits = m.apply({'params': params}, xx)  # aux never runs
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * yy, -1)), model_state
+
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg_aux, factor_update_steps=2, inv_update_steps=2,
+        damping=0.01,
+    )
+    t = training.Trainer(loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=kfac)
+    state = t.init(params)
+    batches = (
+        jnp.broadcast_to(x, (4,) + x.shape),
+        jnp.broadcast_to(y, (4,) + y.shape),
+    )
+    state, losses = t.scan_steps(state, batches)
+    assert np.isfinite(np.asarray(losses)).all()
+    # the unexecuted head's factor is untouched (identity from init)
+    np.testing.assert_array_equal(
+        np.asarray(state.kfac_state.a['aux_head']), np.eye(17)
+    )
+    assert float(jnp.abs(state.kfac_state.a['main_head'] - jnp.eye(17)).max()) > 0
+    # accumulate path too
+    mbs = (
+        jnp.broadcast_to(x, (2,) + x.shape),
+        jnp.broadcast_to(y, (2,) + y.shape),
+    )
+    state, _ = t.step_accumulate_scan(state, mbs)
+    np.testing.assert_array_equal(
+        np.asarray(state.kfac_state.a['aux_head']), np.eye(17)
+    )
