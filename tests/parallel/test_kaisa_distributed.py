@@ -366,3 +366,36 @@ def test_mem_opt_requires_colocated():
     cfg = kfac_tpu.KFACPreconditioner(registry=reg, colocate_factors=False)
     with pytest.raises(ValueError, match='MEM-OPT'):
         DistributedKFAC(config=cfg, mesh=mesh)
+
+
+def test_memory_usage_reads_actual_shard_bytes():
+    """memory_usage must report the real per-device shard footprint:
+    factors always shard over the full mesh; decomps replicate under
+    COMM-OPT and shard by column otherwise."""
+    _, _, _, _, _, _, dk_comm, _ = _setup(1.0)
+    st = dk_comm.init()
+    usage = dk_comm.memory_usage(st)
+    # compute the expectation straight from the arrays' shardings
+    expect_a = sum(
+        int(np.prod(v.sharding.shard_shape(v.shape))) * v.dtype.itemsize
+        for v in st.a.values()
+    )
+    assert usage['a_factors'] == expect_a
+    expect_qa = sum(
+        int(np.prod(v.sharding.shard_shape(v.shape))) * v.dtype.itemsize
+        for v in st.qa.values()
+    )
+    assert usage['a_inverses'] == expect_qa + sum(
+        int(np.prod(v.sharding.shard_shape(v.shape))) * v.dtype.itemsize
+        for v in st.da.values()
+    )
+    # COMM-OPT decomps are replicated: per-device bytes == global bytes
+    for v in st.qa.values():
+        assert np.prod(v.sharding.shard_shape(v.shape)) == v.size
+    # MEM-OPT keeps a 1/world column shard
+    _, _, _, _, _, _, dk_mem, _ = _setup(1 / WORLD)
+    stm = dk_mem.init()
+    um = dk_mem.memory_usage(stm)
+    assert um['a_inverses'] < usage['a_inverses']
+    for v in stm.qa.values():
+        assert np.prod(v.sharding.shard_shape(v.shape)) * WORLD == v.size
