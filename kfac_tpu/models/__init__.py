@@ -1,4 +1,4 @@
-"""Model families: MLP, CIFAR/ImageNet ResNets, Transformer LM."""
+"""Model families: MLP, CIFAR/ImageNet ResNets, Transformer LM, MoE."""
 
 from kfac_tpu.models.mlp import MLP
 from kfac_tpu.models.resnet import (
@@ -9,14 +9,18 @@ from kfac_tpu.models.resnet import (
     resnet50,
     resnet56,
 )
+from kfac_tpu.models.moe import MoEMLP, expert_tp_overrides, load_balance_loss
 from kfac_tpu.models.transformer import TransformerLM, lm_loss
 
 __all__ = [
     'MLP',
+    'MoEMLP',
     'CifarResNet',
     'ImageNetResNet',
     'TransformerLM',
+    'expert_tp_overrides',
     'lm_loss',
+    'load_balance_loss',
     'resnet20',
     'resnet32',
     'resnet50',

@@ -1,0 +1,96 @@
+"""Mixture-of-Experts MLP with per-expert K-FAC factors.
+
+Beyond the reference (gpauloski/kfac-pytorch has no MoE support at all;
+SURVEY.md section 2.3 lists EP as absent in both) — a natural extension of
+the stacked-bucket KAISA design:
+
+- Every expert's projections are ordinary named ``nn.Dense`` submodules
+  (``expert{e}_up`` / ``expert{e}_down``), so each registers as its own
+  K-FAC layer. Experts share factor shapes, so they land in ONE stacked
+  bucket and the distributed engine shards their eigendecompositions across
+  the mesh automatically — "EP factor buckets" fall out of the existing
+  layout with zero engine changes.
+- Dispatch is dense top-1 (switch-style): non-routed token rows are zeroed
+  before the expert's up-projection AND between up and down (so the
+  up-bias cannot leak constant activations into the down layer), and the
+  output is re-masked. Captured factors need no MoE-specific path; two
+  documented approximations remain: every row still contributes the
+  homogeneous bias-ones entry to the A factor's bias corner (unrouted rows
+  add [0,...,0,1] outer products, as zero-input rows do in any dense
+  layer), and the 1/T row normalization is shared by all experts, so each
+  expert's factor is scaled by its routed fraction (a per-layer scalar the
+  damping absorbs).
+- Expert parallelism is a layout choice: stack the expert axis over the
+  ``model`` mesh axis by passing TP overrides (column for ``*_up``, row for
+  ``*_down``) to :func:`kfac_tpu.parallel.tensor_parallel
+  .shard_params_from_registry`, or shard different experts' weights to
+  different devices with per-expert override rules — GSPMD turns the masked
+  dispatch into the corresponding collective.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Top-1 (switch) routed MLP: ``num_experts`` independent FFNs.
+
+    Router probabilities are sown under ``intermediates/router_probs`` so
+    callers can add :func:`load_balance_loss`.
+    """
+
+    num_experts: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d = x.shape[-1]
+        logits = nn.Dense(self.num_experts, dtype=self.dtype, name='router')(x)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        idx = jnp.argmax(probs, axis=-1)                       # (B, S)
+        gate = jnp.take_along_axis(probs, idx[..., None], -1)  # (B, S, 1)
+        self.sow('intermediates', 'router_probs', probs)
+        self.sow('intermediates', 'expert_index', idx)
+
+        out = jnp.zeros_like(x)
+        for e in range(self.num_experts):
+            mask = (idx == e).astype(x.dtype)[..., None]
+            xe = x * mask
+            h = nn.Dense(
+                self.mlp_ratio * d, dtype=self.dtype, name=f'expert{e}_up'
+            )(xe)
+            # re-mask: unrouted rows would otherwise carry gelu(b_up) into
+            # the down projection (and its captured A factor)
+            h = nn.gelu(h) * mask
+            y = nn.Dense(d, dtype=self.dtype, name=f'expert{e}_down')(h)
+            out = out + y * mask
+        return out * gate.astype(out.dtype)
+
+
+def load_balance_loss(probs: jax.Array, idx: jax.Array, num_experts: int):
+    """Switch-Transformer auxiliary load-balancing loss.
+
+    ``num_experts * sum_e f_e * P_e`` where f_e is the fraction of tokens
+    routed to expert e and P_e the mean router probability — minimized (=1)
+    at uniform load.
+    """
+    onehot = jax.nn.one_hot(idx, num_experts, dtype=jnp.float32)
+    f = onehot.reshape(-1, num_experts).mean(0)
+    p = probs.reshape(-1, num_experts).mean(0)
+    return num_experts * jnp.sum(f * p)
+
+
+def expert_tp_overrides(num_experts: int) -> list[tuple[str, str]]:
+    """TP override rules sharding every expert Megatron-style (up =
+    column-parallel, down = row-parallel) over the model axis — the
+    simplest expert-parallel layout."""
+    return [
+        (rf'.*expert\d+_up', 'column'),
+        (rf'.*expert\d+_down', 'row'),
+    ]

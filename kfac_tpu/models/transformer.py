@@ -18,6 +18,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from kfac_tpu.models import moe as moe_lib
+
 
 class CausalSelfAttention(nn.Module):
     """Causal attention with optional context parallelism.
@@ -63,6 +65,7 @@ class Block(nn.Module):
     dtype: Any = jnp.float32
     ring_mesh: Any = None
     ring_axis: str | None = None
+    num_experts: int = 0  # > 0 replaces the dense MLP with a switch MoE
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -73,6 +76,11 @@ class Block(nn.Module):
             ring_axis=self.ring_axis, name='attn',
         )(y)
         y = nn.LayerNorm(dtype=jnp.float32, name='ln2')(x)
+        if self.num_experts > 0:
+            return x + moe_lib.MoEMLP(
+                self.num_experts, self.mlp_ratio, dtype=self.dtype,
+                name='moe',
+            )(y)
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype, name='mlp_up')(y)
         h = nn.gelu(h)
         x = x + nn.Dense(d, dtype=self.dtype, name='mlp_down')(h)
@@ -96,6 +104,10 @@ class TransformerLM(nn.Module):
     remat: bool = False
     ring_mesh: Any = None
     ring_axis: str | None = None
+    # switch-MoE (beyond the reference): every `moe_every`-th block uses
+    # `num_experts` routed FFN experts instead of the dense MLP
+    num_experts: int = 0
+    moe_every: int = 2
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
@@ -111,9 +123,13 @@ class TransformerLM(nn.Module):
         if self.remat:
             block_cls = nn.remat(Block)
         for i in range(self.num_layers):
+            is_moe = (
+                self.num_experts > 0 and (i + 1) % self.moe_every == 0
+            )
             x = block_cls(
                 self.num_heads, self.mlp_ratio, dtype=self.dtype,
                 ring_mesh=self.ring_mesh, ring_axis=self.ring_axis,
+                num_experts=self.num_experts if is_moe else 0,
                 name=f'block{i}',
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name='ln_f')(x.astype(jnp.float32))
