@@ -86,11 +86,11 @@ def load_balance_loss(probs: jax.Array, idx: jax.Array, num_experts: int):
     return num_experts * jnp.sum(f * p)
 
 
-def expert_tp_overrides(num_experts: int) -> list[tuple[str, str]]:
+def expert_tp_overrides() -> list[tuple[str, str]]:
     """TP override rules sharding every expert Megatron-style (up =
     column-parallel, down = row-parallel) over the model axis — the
-    simplest expert-parallel layout."""
+    simplest expert-parallel layout. Matches any expert index."""
     return [
-        (rf'.*expert\d+_up', 'column'),
-        (rf'.*expert\d+_down', 'row'),
+        (r'.*expert\d+_up', 'column'),
+        (r'.*expert\d+_down', 'row'),
     ]

@@ -123,8 +123,11 @@ class TransformerLM(nn.Module):
         if self.remat:
             block_cls = nn.remat(Block)
         for i in range(self.num_layers):
+            # moe_every <= 0 means no MoE blocks (same as num_experts=0)
             is_moe = (
-                self.num_experts > 0 and (i + 1) % self.moe_every == 0
+                self.num_experts > 0
+                and self.moe_every > 0
+                and (i + 1) % self.moe_every == 0
             )
             x = block_cls(
                 self.num_heads, self.mlp_ratio, dtype=self.dtype,

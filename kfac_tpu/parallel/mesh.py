@@ -95,8 +95,8 @@ def pipeline_mesh(
     stage-local, kfac/gpt_neox/assignment.py:95-130), so the KAISA grid
     shape would have no effect. The data axes are kept as
     (kfac_gw=1, kfac_col=dp) so batch/token sharding helpers apply
-    unchanged. Distributing each stage's eigh work across its DP peers is
-    a possible future optimization.
+    unchanged. PipelineKFAC round-robins each stage's eigendecompositions
+    over these DP peers.
     """
     devices = list(devices if devices is not None else jax.devices())
     world = len(devices)

@@ -384,6 +384,17 @@ class PipelineKFAC:
         self.mesh = self.model.mesh
         self.registry = self.model.stage_registry
         self.n_stages = self.model.n_stages
+        # DP axes of a pipeline_mesh: each stage's eigendecompositions
+        # round-robin over these peers instead of being recomputed by every
+        # data replica (eigh work / dp wall-clock), then psum-share.
+        self._dp_axes = tuple(
+            ax
+            for ax in self.mesh.axis_names
+            if ax != PIPE_AXIS and int(self.mesh.shape[ax]) > 1
+        )
+        self._dp_size = 1
+        for ax in self._dp_axes:
+            self._dp_size *= int(self.mesh.shape[ax])
         if self.config.compute_method != enums.ComputeMethod.EIGEN:
             raise NotImplementedError(
                 'PipelineKFAC supports only the EIGEN compute method'
@@ -392,6 +403,13 @@ class PipelineKFAC:
             raise NotImplementedError(
                 'prediv_eigenvalues is not supported by PipelineKFAC'
             )
+
+    def _peer_index(self):
+        """Linear index of this device within the DP axes (inside shard_map)."""
+        idx = jnp.asarray(0, jnp.int32)
+        for ax in self._dp_axes:
+            idx = idx * int(self.mesh.shape[ax]) + jax.lax.axis_index(ax)
+        return idx
 
     def _spec(self):
         return NamedSharding(self.mesh, P(PIPE_AXIS))
@@ -448,7 +466,7 @@ class PipelineKFAC:
             new_a, new_g, new_qa, new_qg, new_da, new_dg = {}, {}, {}, {}, {}, {}
             pre = {}
             vg = jnp.zeros((), jnp.float32)
-            for name in names:
+            for li, name in enumerate(names):
                 h = helpers[name]
                 na_ = jax.lax.cond(
                     do_factors,
@@ -468,10 +486,46 @@ class PipelineKFAC:
                 )
                 new_a[name], new_g[name] = na_, ng_
 
-                def compute(_):
+                def run_eigh(_):
                     adec = factors_lib.compute_eigh(na_, cfg.inv_dtype)
                     gdec = factors_lib.compute_eigh(ng_, cfg.inv_dtype)
                     return adec.q, gdec.q, adec.d, gdec.d
+
+                if self._dp_axes:
+                    # round-robin this layer's eigh over the DP peers of the
+                    # stage, then psum-share: eigh wall-clock divides by dp
+                    # instead of every replica recomputing every layer
+                    owner = li % self._dp_size
+
+                    def vary(t):
+                        return jax.lax.pcast(
+                            t, self._dp_axes, to='varying'
+                        )
+
+                    def dp_compute(_):
+                        out = jax.lax.cond(
+                            self._peer_index() == owner,
+                            lambda _: tuple(map(vary, run_eigh(None))),
+                            lambda _: tuple(
+                                map(
+                                    vary,
+                                    (
+                                        jnp.zeros_like(qa[name]),
+                                        jnp.zeros_like(qg[name]),
+                                        jnp.zeros_like(da[name]),
+                                        jnp.zeros_like(dg[name]),
+                                    ),
+                                )
+                            ),
+                            None,
+                        )
+                        return tuple(
+                            jax.lax.psum(t, self._dp_axes) for t in out
+                        )
+
+                    compute = dp_compute
+                else:
+                    compute = run_eigh
 
                 qa_, qg_, da_, dg_ = jax.lax.cond(
                     do_inverses,

@@ -99,7 +99,7 @@ def test_moe_expert_parallel_layout():
     params = m.init(jax.random.PRNGKey(1), tokens)['params']
     reg = kfac_tpu.register_model(m, tokens)
     specs = tensor_parallel.registry_param_specs(
-        params, reg, overrides=moe.expert_tp_overrides(4),
+        params, reg, overrides=moe.expert_tp_overrides(),
         warn_unmatched=False,
     )
     from jax.sharding import PartitionSpec as P
@@ -107,7 +107,7 @@ def test_moe_expert_parallel_layout():
     assert specs['block1']['moe']['expert0_up']['kernel'] == P(None, 'model')
     assert specs['block1']['moe']['expert0_down']['kernel'] == P('model', None)
     tp_params = tensor_parallel.shard_params_from_registry(
-        params, mesh, reg, overrides=moe.expert_tp_overrides(4),
+        params, mesh, reg, overrides=moe.expert_tp_overrides(),
         warn_unmatched=False,
     )
     run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(lm_loss(m))
