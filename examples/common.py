@@ -17,6 +17,15 @@ import optax
 import kfac_tpu
 
 
+def distributed_init() -> None:
+    """Join the multi-host world before first backend use (no-op on a
+    single host). Trainers call this first so ``jax.devices()`` sees the
+    global world under ``scripts/run_pod.sh`` / TPU pod launches."""
+    from kfac_tpu.parallel import multihost
+
+    multihost.initialize()
+
+
 def add_kfac_args(parser: argparse.ArgumentParser) -> None:
     """The reference's K-FAC CLI surface
     (examples/torch_cifar10_resnet.py:148-237)."""

@@ -37,6 +37,8 @@ def main(argv=None) -> float:
     common.add_kfac_args(p)
     args = p.parse_args(argv)
 
+    common.distributed_init()
+
     world = len(jax.devices())
     frac = common.strategy_fraction(args.kfac_strategy, world)
     mesh = kaisa_mesh(grad_worker_fraction=frac)

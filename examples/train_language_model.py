@@ -43,6 +43,8 @@ def main(argv=None) -> float:
     common.add_kfac_args(p)
     args = p.parse_args(argv)
 
+    common.distributed_init()
+
     world = len(jax.devices())
     dp = world // (args.model_shards * args.seq_shards)
     frac = common.strategy_fraction(args.kfac_strategy, dp)

@@ -11,6 +11,7 @@ DCN and keep factor/eigh traffic inside a slice.
 
 from __future__ import annotations
 
+import os
 from typing import Sequence
 
 import jax
@@ -29,11 +30,33 @@ def initialize(
     """Bring up the JAX distributed runtime (no-op if single-process).
 
     On TPU pods the arguments are auto-detected from the environment; on
-    other platforms pass them explicitly (the torchrun-rendezvous
-    equivalent).
+    other platforms pass them explicitly or export
+    ``KFAC_TPU_COORDINATOR`` / ``KFAC_TPU_NUM_PROCESSES`` /
+    ``KFAC_TPU_PROCESS_ID`` (what ``scripts/run_pod.sh`` sets per node —
+    the torchrun-rendezvous equivalent).
     """
+    if coordinator_address is None:
+        coordinator_address = os.environ.get('KFAC_TPU_COORDINATOR')
+    if num_processes is None and 'KFAC_TPU_NUM_PROCESSES' in os.environ:
+        num_processes = int(os.environ['KFAC_TPU_NUM_PROCESSES'])
+    if process_id is None and 'KFAC_TPU_PROCESS_ID' in os.environ:
+        process_id = int(os.environ['KFAC_TPU_PROCESS_ID'])
     if num_processes is not None and num_processes <= 1:
         return
+    if coordinator_address is None and num_processes is None:
+        # No explicit rendezvous: initialize only when the environment
+        # says this host is part of a MULTI-host pod/cluster; on a single
+        # host (incl. single-worker TPU VMs, which still export
+        # TPU_WORKER_HOSTNAMES with one entry) there is nothing to set up
+        # and jax.distributed.initialize would raise.
+        hosts = os.environ.get('TPU_WORKER_HOSTNAMES', '')
+        n_tpu_hosts = len([h for h in hosts.split(',') if h.strip()])
+        n_slurm = int(os.environ.get('SLURM_JOB_NUM_NODES', '1') or 1)
+        multislice = 'MEGASCALE_COORDINATOR_ADDRESS' in os.environ
+        if n_tpu_hosts <= 1 and n_slurm <= 1 and not multislice:
+            return
+        # in a detected multi-host environment, failures are real and
+        # must surface
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Kill stale JAX/python processes holding TPU chips on every worker
+# (reference scripts/kill_python_procs.sh equivalent).
+#
+# libtpu is single-client per chip: a crashed or orphaned trainer keeps the
+# chips claimed (accel lockfiles under /tmp/libtpu_lockfile) and every new
+# launch hangs in backend init. Run this between failed jobs.
+#
+# USAGE
+#
+#   $ TPU_NAME=my-v5e-64 ZONE=us-east5-a ./scripts/kill_stale_jax.sh
+#   $ NODEFILE=/path/to/nodes ./scripts/kill_stale_jax.sh
+#   $ ./scripts/kill_stale_jax.sh            # local host only
+
+set -uo pipefail
+
+read -r -d '' CLEAN <<'EOF' || true
+# politely TERM first (a SIGKILLed process can wedge the chip claim),
+# then KILL what survives
+PIDS=$(pgrep -f 'python.*(train_|kfac_tpu|jax)' | grep -v "^$$\$" || true)
+if [ -n "$PIDS" ]; then
+    echo "$(hostname): terminating: $PIDS"
+    kill $PIDS 2>/dev/null
+    sleep 5
+    kill -9 $PIDS 2>/dev/null
+fi
+rm -f /tmp/libtpu_lockfile /tmp/tpu_logs/* 2>/dev/null
+echo "$(hostname): clean"
+EOF
+
+if [[ -n "${TPU_NAME:-}" ]]; then
+    exec gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
+        ${ZONE:+--zone="$ZONE"} --worker=all --command="$CLEAN"
+fi
+
+if [[ -z "${NODEFILE:-}" && -n "${SLURM_NODELIST:-}" ]]; then
+    NODEFILE=$(mktemp)
+    scontrol show hostnames "$SLURM_NODELIST" > "$NODEFILE"
+fi
+
+if [[ -z "${NODEFILE:-}" ]]; then
+    bash -c "$CLEAN"
+else
+    while read -r NODE; do
+        ssh "$NODE" "$CLEAN" &
+    done < "$NODEFILE"
+    wait
+fi

@@ -1,0 +1,87 @@
+#!/bin/bash
+# Launch a kfac_tpu example trainer across every host of a TPU pod slice
+# (or a SLURM/ssh CPU cluster for testing).
+#
+# TPU-native replacement for the reference's torchrun+ssh launcher
+# (reference scripts/run_imagenet.sh): JAX runs ONE process per host, each
+# seeing the host's local chips; `jax.distributed.initialize` (called by
+# kfac_tpu.parallel.multihost.initialize inside the trainers) federates them
+# into one global device world. On Cloud TPU the coordinator/process-count/
+# process-id are auto-detected from the TPU metadata, so the launcher's only
+# job is to start the same command on every worker.
+#
+# USAGE
+#
+#   Cloud TPU pod slice (run from your workstation / login VM):
+#
+#     $ TPU_NAME=my-v5e-64 ZONE=us-east5-a ./scripts/run_pod.sh \
+#           examples/train_imagenet_resnet.py --data-dir /data/imagenet
+#
+#   SLURM allocation (one process per node; CPU or GPU backends):
+#
+#     $ sbatch -N 8 ./scripts/run_pod.sh examples/train_language_model.py
+#
+#   Single host (degenerates to plain python):
+#
+#     $ ./scripts/run_pod.sh examples/train_cifar_resnet.py --epochs 10
+#
+# Extra arguments are passed through to the training script verbatim.
+
+set -euo pipefail
+
+PRELOAD="${PRELOAD:-}"          # e.g. "source ~/venv/bin/activate ;"
+PYTHON="${PYTHON:-python3}"
+REPO_DIR="${REPO_DIR:-$PWD}"
+
+if [[ $# -lt 1 ]]; then
+    echo "usage: $0 <training_script.py> [args...]" >&2
+    exit 2
+fi
+CMD="$PYTHON $*"
+
+if [[ -n "${TPU_NAME:-}" ]]; then
+    # --- Cloud TPU pod slice: fan out via the TPU VM ssh helper ---------
+    # Each worker auto-discovers coordinator + process_id from metadata;
+    # no rendezvous flags needed.
+    echo "Launching on TPU pod ${TPU_NAME} (all workers): $CMD"
+    exec gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
+        ${ZONE:+--zone="$ZONE"} --worker=all \
+        --command="cd $REPO_DIR; $PRELOAD $CMD"
+fi
+
+# --- SLURM / nodefile clusters: one process per host ---------------------
+if [[ -z "${NODEFILE:-}" && -n "${SLURM_NODELIST:-}" ]]; then
+    NODEFILE=$(mktemp)
+    scontrol show hostnames "$SLURM_NODELIST" > "$NODEFILE"
+fi
+
+if [[ -z "${NODEFILE:-}" ]]; then
+    echo "Single host: $CMD"
+    eval "$PRELOAD $CMD"
+    exit $?
+fi
+
+MAIN_RANK=$(head -n 1 "$NODEFILE")
+NNODES=$(wc -l < "$NODEFILE")
+PORT="${COORDINATOR_PORT:-8476}"
+echo "Launching on $NNODES nodes, coordinator ${MAIN_RANK}:${PORT}: $CMD"
+
+# kfac_tpu.parallel.multihost.initialize reads these when TPU metadata is
+# absent (CPU/GPU backends need explicit rendezvous, like torchrun's c10d).
+RANK=0
+while read -r NODE; do
+    ENV="KFAC_TPU_COORDINATOR=${MAIN_RANK}:${PORT}"
+    ENV+=" KFAC_TPU_NUM_PROCESSES=${NNODES} KFAC_TPU_PROCESS_ID=${RANK}"
+    if [[ "$NODE" == "$(hostname)" || "$NODE" == "$(hostname -s)" ]]; then
+        echo "  rank $RANK on local node $NODE"
+        # subshell + export so the vars reach the trainer even when
+        # PRELOAD is a compound command
+        (export $ENV; eval "$PRELOAD $CMD") &
+    else
+        echo "  rank $RANK on remote node $NODE"
+        ssh "$NODE" "cd $REPO_DIR; export $ENV; $PRELOAD $CMD" &
+    fi
+    RANK=$((RANK + 1))
+done < "$NODEFILE"
+
+wait

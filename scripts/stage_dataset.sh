@@ -1,0 +1,72 @@
+#!/bin/bash
+# Stage a dataset archive onto the local disk of every worker before
+# training (reference scripts/copy_and_extract.sh equivalent).
+#
+# TPU pods read training data from each host's local NVMe/ssd, not a shared
+# filesystem — the kfac_tpu native loader (kfac_tpu/utils/native_loader.py)
+# memory-maps .npy/.npz files, so they must exist locally on every host.
+#
+# USAGE
+#
+#   Cloud TPU pod slice (fans out over all workers):
+#
+#     $ TPU_NAME=my-v5e-64 ZONE=us-east5-a \
+#           ./scripts/stage_dataset.sh gs://bucket/imagenet.tar /tmp/imagenet
+#
+#   SLURM / nodefile cluster:
+#
+#     $ NODEFILE=$COBALT_NODEFILE \
+#           ./scripts/stage_dataset.sh /lustre/imagenet.tar /tmp/imagenet
+#
+# The source may be a gs:// URL (fetched with gsutil on each worker) or a
+# path visible from every node. Extraction is skipped when the destination
+# already contains files (idempotent re-runs).
+
+set -euo pipefail
+
+if [[ $# -ne 2 ]]; then
+    echo "usage: $0 <archive (.tar[.gz] or gs:// URL)> <dest-dir>" >&2
+    exit 2
+fi
+SRC="$1"
+DEST="$2"
+
+# the per-worker staging command (runs on each host)
+read -r -d '' STAGE <<EOF || true
+set -e
+if [ -d "$DEST" ] && [ -n "\$(ls -A "$DEST" 2>/dev/null)" ]; then
+    echo "\$(hostname): $DEST already staged, skipping"
+    exit 0
+fi
+mkdir -p "$DEST"
+case "$SRC" in
+    gs://*) gsutil -q cp "$SRC" "$DEST/_archive" ;;
+    *)      cp "$SRC" "$DEST/_archive" ;;
+esac
+case "$SRC" in
+    *.tar.gz|*.tgz) tar -xzf "$DEST/_archive" -C "$DEST" ;;
+    *.tar)          tar -xf  "$DEST/_archive" -C "$DEST" ;;
+    *)              mv "$DEST/_archive" "$DEST/\$(basename "$SRC")" ;;
+esac
+rm -f "$DEST/_archive"
+echo "\$(hostname): staged $SRC -> $DEST"
+EOF
+
+if [[ -n "${TPU_NAME:-}" ]]; then
+    exec gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
+        ${ZONE:+--zone="$ZONE"} --worker=all --command="$STAGE"
+fi
+
+if [[ -z "${NODEFILE:-}" && -n "${SLURM_NODELIST:-}" ]]; then
+    NODEFILE=$(mktemp)
+    scontrol show hostnames "$SLURM_NODELIST" > "$NODEFILE"
+fi
+
+if [[ -z "${NODEFILE:-}" ]]; then
+    bash -c "$STAGE"
+else
+    while read -r NODE; do
+        ssh "$NODE" "$STAGE" &
+    done < "$NODEFILE"
+    wait
+fi
