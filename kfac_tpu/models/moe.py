@@ -10,16 +10,29 @@ the stacked-bucket KAISA design:
   bucket and the distributed engine shards their eigendecompositions across
   the mesh automatically — "EP factor buckets" fall out of the existing
   layout with zero engine changes.
-- Dispatch is dense top-1 (switch-style): non-routed token rows are zeroed
-  before the expert's up-projection AND between up and down (so the
-  up-bias cannot leak constant activations into the down layer), and the
-  output is re-masked. Captured factors need no MoE-specific path; two
-  documented approximations remain: every row still contributes the
-  homogeneous bias-ones entry to the A factor's bias corner (unrouted rows
-  add [0,...,0,1] outer products, as zero-input rows do in any dense
-  layer), and the 1/T row normalization is shared by all experts, so each
-  expert's factor is scaled by its routed fraction (a per-layer scalar the
-  damping absorbs).
+- Dispatch is top-1 (switch-style) with two execution paths sharing one
+  parameter structure:
+  * ``capacity_factor=None`` — dense masked dispatch: every expert sees
+    every (masked) token row. Simple, exact, E× FLOPs; right for tests
+    and tiny expert counts.
+  * ``capacity_factor=c`` — capacity dispatch: tokens are packed into
+    per-expert buffers of ``C = ceil(c * T / E)`` slots through one-hot
+    dispatch einsums (MXU-friendly, differentiable; the Mesh-TF/Switch
+    formulation), each expert runs on its C rows only, and outputs
+    combine back by the transposed einsum. Total FFN FLOPs are
+    ``c * T`` tokens' worth regardless of E; tokens beyond an expert's
+    capacity are dropped (residual passthrough, standard switch
+    semantics).
+  In both paths non-routed/empty rows are zeroed before the up-projection
+  AND between up and down (so the up-bias cannot leak constant
+  activations into the down layer). Captured factors need no
+  MoE-specific path; two documented approximations remain: every
+  buffer row still contributes the homogeneous bias-ones entry to the A
+  factor's bias corner (empty rows add [0,...,0,1] outer products, as
+  zero-input rows do in any dense layer), and the row normalization
+  (1/T dense, 1/C capacity) is shared per layer, so each expert's factor
+  is scaled by its routed fraction (a per-layer scalar the damping
+  absorbs).
 - Expert parallelism is a layout choice: stack the expert axis over the
   ``model`` mesh axis by passing TP overrides (column for ``*_up``, row for
   ``*_down``) to :func:`kfac_tpu.parallel.tensor_parallel
@@ -30,6 +43,7 @@ the stacked-bucket KAISA design:
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import flax.linen as nn
@@ -42,11 +56,18 @@ class MoEMLP(nn.Module):
 
     Router probabilities are sown under ``intermediates/router_probs`` so
     callers can add :func:`load_balance_loss`.
+
+    ``capacity_factor=None`` runs the dense masked path (every expert sees
+    all tokens, exact); a float enables capacity dispatch with
+    ``ceil(capacity_factor * tokens / num_experts)`` slots per expert —
+    sparse compute, overflow tokens dropped. Both paths share the same
+    parameter structure, so a model can train dense and serve sparse.
     """
 
     num_experts: int
     mlp_ratio: int = 4
     dtype: Any = jnp.float32
+    capacity_factor: float | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -57,6 +78,9 @@ class MoEMLP(nn.Module):
         gate = jnp.take_along_axis(probs, idx[..., None], -1)  # (B, S, 1)
         self.sow('intermediates', 'router_probs', probs)
         self.sow('intermediates', 'expert_index', idx)
+
+        if self.capacity_factor is not None:
+            return self._capacity_dispatch(x, idx) * gate.astype(x.dtype)
 
         out = jnp.zeros_like(x)
         for e in range(self.num_experts):
@@ -71,6 +95,43 @@ class MoEMLP(nn.Module):
             y = nn.Dense(d, dtype=self.dtype, name=f'expert{e}_down')(h)
             out = out + y * mask
         return out * gate.astype(out.dtype)
+
+    def _capacity_dispatch(self, x: jax.Array, idx: jax.Array) -> jax.Array:
+        """Pack routed tokens into per-expert capacity buffers and run each
+        expert on its buffer only.
+
+        The dispatch tensor ``disp[t, e, s]`` is 1 when flat token t holds
+        slot s of expert e (one-hot over slots; all-zero for dropped or
+        unrouted tokens), so dispatch and combine are plain matmuls the MXU
+        tiles well, and both are exactly differentiable — the backward pass
+        is the transposed einsum, which is the combine/dispatch of the
+        cotangents (XLA sees static shapes throughout; no dynamic gather).
+        """
+        d = x.shape[-1]
+        lead = x.shape[:-1]
+        t = math.prod(lead)
+        cap = max(1, math.ceil(self.capacity_factor * t / self.num_experts))
+        xf = x.reshape(t, d)
+        idxf = idx.reshape(t)
+        onehot = jax.nn.one_hot(idxf, self.num_experts, dtype=jnp.int32)
+        # slot of token t within its expert's buffer (arrival order); -1
+        # for the experts it is not routed to
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1           # (T, E)
+        pos = jnp.where(pos < cap, pos, -1)                      # drop overflow
+        out_f = jnp.zeros_like(xf)
+        for e in range(self.num_experts):
+            de = jax.nn.one_hot(pos[:, e], cap, dtype=x.dtype)   # (T, C)
+            xe = jnp.einsum('tc,td->cd', de, xf)                 # (C, d)
+            h = nn.Dense(
+                self.mlp_ratio * d, dtype=self.dtype, name=f'expert{e}_up'
+            )(xe)
+            # zero empty slots between up and down: gelu(b_up) must not
+            # reach the down projection (same hygiene as the dense path)
+            used = jnp.sum(de, axis=0)[:, None].astype(h.dtype)  # (C, 1)
+            h = nn.gelu(h) * used
+            y = nn.Dense(d, dtype=self.dtype, name=f'expert{e}_down')(h)
+            out_f = out_f + jnp.einsum('tc,cd->td', de, y)
+        return out_f.reshape(*lead, d)
 
 
 def load_balance_loss(probs: jax.Array, idx: jax.Array, num_experts: int):

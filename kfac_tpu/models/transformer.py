@@ -66,6 +66,7 @@ class Block(nn.Module):
     ring_mesh: Any = None
     ring_axis: str | None = None
     num_experts: int = 0  # > 0 replaces the dense MLP with a switch MoE
+    moe_capacity_factor: float | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -79,6 +80,7 @@ class Block(nn.Module):
         if self.num_experts > 0:
             return x + moe_lib.MoEMLP(
                 self.num_experts, self.mlp_ratio, dtype=self.dtype,
+                capacity_factor=self.moe_capacity_factor,
                 name='moe',
             )(y)
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype, name='mlp_up')(y)
@@ -108,6 +110,7 @@ class TransformerLM(nn.Module):
     # `num_experts` routed FFN experts instead of the dense MLP
     num_experts: int = 0
     moe_every: int = 2
+    moe_capacity_factor: float | None = None
 
     @nn.compact
     def __call__(self, tokens: jax.Array) -> jax.Array:
@@ -133,6 +136,7 @@ class TransformerLM(nn.Module):
                 self.num_heads, self.mlp_ratio, dtype=self.dtype,
                 ring_mesh=self.ring_mesh, ring_axis=self.ring_axis,
                 num_experts=self.num_experts if is_moe else 0,
+                moe_capacity_factor=self.moe_capacity_factor,
                 name=f'block{i}',
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name='ln_f')(x.astype(jnp.float32))
