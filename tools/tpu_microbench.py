@@ -1,0 +1,146 @@
+"""TPU microbenchmarks for the K-FAC hot ops: run on the real chip to pick
+factor-op implementations (eigh vs Cholesky vs Newton-Schulz) and validate
+the Pallas triangular covariance against XLA's dense contraction.
+
+Usage: python tools/tpu_microbench.py [--sizes 512 2048] [--iters 20]
+Prints one JSON line per measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, iters=20, warmup=1):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def report(name, seconds, **extra):
+    print(json.dumps({'op': name, 'ms': round(seconds * 1e3, 3), **extra}),
+          flush=True)
+
+
+def newton_schulz_inverse(a, damping, iters=25):
+    """(a + damping*I)^-1 by Newton-Schulz: X_{k+1} = X_k (2I - M X_k).
+
+    Pure matmuls (MXU-native). Converges when ||I - M X_0|| < 1; the init
+    X_0 = I/trace(M) guarantees that for SPD M since trace(M) > lambda_max.
+    """
+    d = a.shape[-1]
+    eye = jnp.eye(d, dtype=jnp.float32)
+    m = a.astype(jnp.float32) + damping * eye
+    x = eye / jnp.trace(m)
+    for _ in range(iters):
+        x = x @ (2.0 * eye - m @ x)
+    return x
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--sizes', type=int, nargs='*', default=[512, 2048])
+    p.add_argument('--iters', type=int, default=20)
+    p.add_argument('--rows', type=int, default=8192)
+    args = p.parse_args()
+
+    dev = jax.devices()[0]
+    print(json.dumps({'platform': dev.platform,
+                      'device_kind': getattr(dev, 'device_kind', '')}),
+          flush=True)
+
+    # --- clock validation: known-FLOPs matmul chain -----------------------
+    n = 4096
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm_chain(a):
+        x = a
+        for _ in range(8):
+            x = x @ a
+        return x
+
+    t = timeit(mm_chain, a, iters=args.iters)
+    flops = 8 * 2 * n**3
+    report('matmul4096_bf16_chain8', t, tflops=round(flops / t / 1e12, 1))
+
+    for d in args.sizes:
+        m = jax.random.normal(jax.random.PRNGKey(d), (args.rows, d),
+                              jnp.float32)
+        cov = (m.T @ m) / args.rows  # SPD test matrix
+
+        # eigh: single and vmap-batched x4
+        f = jax.jit(lambda c: jnp.linalg.eigh(c))
+        t = timeit(f, cov, iters=max(3, args.iters // 4))
+        report(f'eigh_{d}', t)
+        stack = jnp.broadcast_to(cov, (4, d, d))
+        fb = jax.jit(jax.vmap(jnp.linalg.eigh))
+        t4 = timeit(fb, stack, iters=max(3, args.iters // 4))
+        report(f'eigh_{d}_vmap4', t4, per_matrix_ms=round(t4 / 4 * 1e3, 3))
+
+        # cholesky factor + solve against identity (the INVERSE method)
+        def chol_inv(c):
+            l = jax.scipy.linalg.cho_factor(
+                c + 0.003 * jnp.eye(d, dtype=c.dtype)
+            )
+            return jax.scipy.linalg.cho_solve(l, jnp.eye(d, dtype=c.dtype))
+
+        t = timeit(jax.jit(chol_inv), cov, iters=max(3, args.iters // 4))
+        report(f'cholesky_inv_{d}', t)
+
+        # Newton-Schulz inverse: matmul-only
+        ns = jax.jit(lambda c: newton_schulz_inverse(c, 0.003))
+        t = timeit(ns, cov, iters=args.iters)
+        x = ns(cov)
+        err = float(jnp.abs(
+            x @ (cov + 0.003 * jnp.eye(d)) - jnp.eye(d)
+        ).max())
+        report(f'newton_schulz25_{d}', t, residual_inf=round(err, 6))
+
+        # covariance: XLA dense contraction vs Pallas triangular kernel
+        for dt, tag in ((jnp.float32, 'f32'), (jnp.bfloat16, 'bf16')):
+            md = m.astype(dt)
+            dense = jax.jit(
+                lambda a: jax.lax.dot_general(
+                    a, a, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) / a.shape[0]
+            )
+            t = timeit(dense, md, iters=args.iters)
+            report(f'cov_dense_{d}_{tag}', t)
+            try:
+                from kfac_tpu.ops import pallas_cov
+
+                t = timeit(
+                    jax.jit(lambda a: pallas_cov.sym_cov(a)), md,
+                    iters=args.iters,
+                )
+                got = pallas_cov.sym_cov(md)
+                want = dense(md).astype(got.dtype)
+                err = float(jnp.abs(
+                    got.astype(jnp.float32) - want.astype(jnp.float32)
+                ).max())
+                report(f'cov_pallas_{d}_{tag}', t, max_err=round(err, 5))
+            except Exception as exc:  # noqa: BLE001
+                report(f'cov_pallas_{d}_{tag}', float('nan'),
+                       error=f'{type(exc).__name__}: {exc}')
+
+
+if __name__ == '__main__':
+    main()
