@@ -86,6 +86,23 @@ class PipelinedLM:
     # traded for ~1/3 extra stage FLOPs instead of a hand-scheduled
     # backward (XLA recomputes inside the scan's transpose).
     remat: bool = True
+    # 'gpipe': forward scan + autodiff transpose (residual memory grows
+    # with n_microbatches: the scan saves one stage input per tick).
+    # '1f1b': ONE combined scan computes forward and backward slots per
+    # tick — stage s runs F of microbatch (t - s) and B of microbatch
+    # (t - (2S-2-s)); the last stage computes head+loss+cotangent in-tick
+    # so backward drains while the pipe is still filling. STAGE residual
+    # memory is a (2S-1)-slot ring regardless of n_microbatches — the 1F1B
+    # memory bound (vs DeepSpeed's PipelineEngine schedule the reference
+    # rides, kfac/gpt_neox/preconditioner.py:70-73); the O(M) buffers that
+    # remain are the model's own input feed and the stage-0 input-cotangent
+    # collection for the embed backward (GPipe carries both too, PLUS one
+    # saved stage input per tick). The bubble fraction (2S-2)/(M+2S-2) can
+    # therefore be amortized with as many microbatches as the batch
+    # affords. Loss, parameter grads, AND the K-FAC A/G statistics come
+    # out of the same scan: B slots recompute the stage forward under an
+    # explicit jax.vjp with the capture interceptor + g-taps attached.
+    schedule: str = 'gpipe'
 
     def __post_init__(self) -> None:
         import warnings as _warnings
@@ -98,6 +115,10 @@ class PipelinedLM:
             ExperimentalFeatureWarning,
             stacklevel=2,
         )
+        if self.schedule not in ('gpipe', '1f1b'):
+            raise ValueError(
+                f"unknown schedule {self.schedule!r}: 'gpipe' or '1f1b'"
+            )
         self.n_stages = int(self.mesh.shape[PIPE_AXIS])
         # Every non-pipe mesh axis is a data-parallel axis: the batch shards
         # over them and factor statistics reduce over them (the reference's
@@ -152,6 +173,55 @@ class PipelinedLM:
 
     # ----------------------------------------------------------- pipeline
 
+    def _stage_apply_captured(self, sp, gst, x, valid):
+        """One stage application with curvature taps attached.
+
+        Returns ``(y, tick_a)``: the stage output with g-taps wrapped
+        around every registered layer (their vjp emits G factors into the
+        ``gst`` dummies' cotangents) and the per-layer A factors of this
+        application, masked by ``valid``. Shared by the GPipe forward body
+        and the 1F1B backward-slot recompute so capture semantics cannot
+        diverge between schedules.
+        """
+        registry = self.stage_registry
+        tick_a: dict[str, jax.Array] = {}
+
+        def interceptor(next_fun, iargs, ikwargs, context):
+            mod = context.module
+            if context.method_name != '__call__' or not iargs:
+                return next_fun(*iargs, **ikwargs)
+            name = registry_lib.path_name(mod.path)
+            helper = registry.layers.get(name)
+            if helper is None:
+                return next_fun(*iargs, **ikwargs)
+            a = jax.lax.stop_gradient(iargs[0])
+            tick_a[name] = tick_a.get(name, 0.0) + (
+                helper.get_a_factor(a) * valid
+            )
+            y = next_fun(*iargs, **ikwargs)
+            # masked ticks contribute zero: their outputs never reach the
+            # loss, so cotangents — and G contributions — are exactly zero
+            return self._gtaps[name](y, gst[name])
+
+        with nn.intercept_methods(interceptor):
+            y = self.stage.apply({'params': sp}, x)
+        return y, tick_a
+
+    def _validate_batch(self, b: int) -> int:
+        """Check batch divisibility; returns the data-parallel world."""
+        m = self.n_microbatches
+        if b % m != 0:
+            raise ValueError(f'batch {b} not divisible by {m} microbatches')
+        dp = 1
+        for ax in self.data_axes:
+            dp *= int(self.mesh.shape[ax])
+        if (b // m) % dp != 0:
+            raise ValueError(
+                f'per-microbatch batch {b // m} not divisible by the '
+                f'data-parallel world {dp}'
+            )
+        return dp
+
     def _pipeline_body(self, stage_params, x_feed, gstats):
         """shard_map body: local stage over all ticks of the schedule.
 
@@ -193,28 +263,7 @@ class PipelinedLM:
         registry = self.stage_registry
 
         def apply_stage(x, valid):
-            """One stage application with curvature taps (locally scoped)."""
-            tick_a: dict[str, jax.Array] = {}
-
-            def interceptor(next_fun, iargs, ikwargs, context):
-                mod = context.module
-                if context.method_name != '__call__' or not iargs:
-                    return next_fun(*iargs, **ikwargs)
-                name = registry_lib.path_name(mod.path)
-                helper = registry.layers.get(name)
-                if helper is None:
-                    return next_fun(*iargs, **ikwargs)
-                a = jax.lax.stop_gradient(iargs[0])
-                a_fac = helper.get_a_factor(a) * valid
-                tick_a[name] = tick_a.get(name, 0.0) + a_fac
-                y = next_fun(*iargs, **ikwargs)
-                # bubble outputs are masked from the loss, so their
-                # cotangents — and G contributions — are exactly zero.
-                return self._gtaps[name](y, gst[name])
-
-            with nn.intercept_methods(interceptor):
-                y = self.stage.apply({'params': sp}, x)
-            return y, tick_a
+            return self._stage_apply_captured(sp, gst, x, valid)
 
         if self.remat:
             apply_stage = jax.checkpoint(apply_stage)
@@ -310,16 +359,7 @@ class PipelinedLM:
             gstats = self.zero_gstats()
         b, s = tokens.shape
         m = self.n_microbatches
-        if b % m != 0:
-            raise ValueError(f'batch {b} not divisible by {m} microbatches')
-        dp = 1
-        for ax in self.data_axes:
-            dp *= int(self.mesh.shape[ax])
-        if (b // m) % dp != 0:
-            raise ValueError(
-                f'per-microbatch batch {b // m} not divisible by the '
-                f'data-parallel world {dp}'
-            )
+        self._validate_batch(b)
         x = self._embed(params, tokens)
         x_feed = x.reshape(m, b // m, s, self.d_model)
 
@@ -338,10 +378,315 @@ class PipelinedLM:
         logits = self.head.apply({'params': params['head']}, x)
         return logits, a_stats, counts
 
+    # ------------------------------------------------------------- 1f1b
+
+    def _body_1f1b(
+        self, stage_params, head_params, lnf_params, x_feed, t_feed, gstats
+    ):
+        """shard_map body: the combined F/B schedule over all ticks.
+
+        Args (local views):
+            stage_params: this stage's params (leading dim 1).
+            head_params / lnf_params: replicated head + final-norm params.
+            x_feed: (M, B_m, S, D) microbatch activations.
+            t_feed: (M, B_m, S) target ids.
+            gstats: zero g-tap dummies, leading dim 1 (this stage's slice).
+        Returns (local views):
+            loss_sum: () local sum of token NLLs / total_tokens.
+            stage_grads: this stage's param grads (leading dim 1).
+            head_grads / lnf_grads: zero except on the last stage.
+            a_stats / g_stats: dict name -> (1, d, d) summed statistics.
+            counts: (1,) microbatches processed by this stage's B slots.
+            xbar: (M, B_m, S, D) input cotangents (real on stage 0 only).
+        """
+        sp = jax.tree_util.tree_map(lambda x: x[0], stage_params)
+        gst = {k: v[0] for k, v in gstats.items()}
+        n = self.n_stages
+        m = self.n_microbatches
+        registry = self.stage_registry
+        all_axes = (PIPE_AXIS,) + self.data_axes
+        if self.data_axes:
+            vary = lambda t: jax.tree_util.tree_map(
+                lambda v: jax.lax.pcast(v, self.data_axes, to='varying'), t
+            )
+            sp, gst = vary(sp), vary(gst)
+            x_feed = jax.lax.pcast(x_feed, (PIPE_AXIS,), to='varying')
+            t_feed = jax.lax.pcast(t_feed, (PIPE_AXIS,), to='varying')
+        # head/ln_f arrive fully replicated (P()): vary over every axis so
+        # the cond branches and accumulators agree
+        head_params, lnf_params = jax.tree_util.tree_map(
+            lambda v: jax.lax.pcast(v, all_axes, to='varying'),
+            (head_params, lnf_params),
+        )
+        stage_idx = jax.lax.axis_index(PIPE_AXIS)
+        if self.data_axes:
+            stage_idx = jax.lax.pcast(stage_idx, self.data_axes, to='varying')
+        b_m, s_len, d = x_feed.shape[1:]
+        ticks = m + 2 * n - 2
+        ring = 2 * n - 1
+        dp = 1
+        for ax in self.data_axes:
+            dp *= int(self.mesh.shape[ax])
+        total_tokens = float(m * b_m * s_len * dp)
+        fwd_perm = [(j, (j + 1) % n) for j in range(n)]
+        bwd_perm = [(j, (j - 1) % n) for j in range(n)]
+
+        def head_loss(y, hp, lp, tgt):
+            """Summed token NLL / total_tokens for one microbatch."""
+            yl = self.ln_f.apply({'params': lp}, y.astype(jnp.float32))
+            logits = self.head.apply({'params': hp}, yl)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+            return -jnp.sum(ll) / total_tokens
+
+        zero_a = {
+            name: jnp.zeros(h.a_factor_shape, jnp.float32)
+            for name, h in registry.layers.items()
+        }
+        zeros_like_vary = lambda t: jax.tree_util.tree_map(
+            lambda v: jax.lax.pcast(
+                jnp.zeros(v.shape, v.dtype), all_axes, to='varying'
+            ),
+            t,
+        )
+
+        carry0 = dict(
+            x_f=zeros_like_vary(jnp.zeros((b_m, s_len, d), self.dtype)),
+            g_b=zeros_like_vary(jnp.zeros((b_m, s_len, d), self.dtype)),
+            resid=zeros_like_vary(jnp.zeros((ring, b_m, s_len, d), self.dtype)),
+            xbar=zeros_like_vary(jnp.zeros((m, b_m, s_len, d), self.dtype)),
+            loss=zeros_like_vary(jnp.zeros((), jnp.float32)),
+            sgrads=zeros_like_vary(
+                jax.tree_util.tree_map(jnp.zeros_like, sp)
+            ),
+            hgrads=zeros_like_vary(
+                jax.tree_util.tree_map(
+                    lambda v: jnp.zeros_like(v, jnp.float32), head_params
+                )
+            ),
+            lgrads=zeros_like_vary(
+                jax.tree_util.tree_map(
+                    lambda v: jnp.zeros_like(v, jnp.float32), lnf_params
+                )
+            ),
+            a_acc=zeros_like_vary(zero_a),
+            g_acc=zeros_like_vary(
+                {k: jnp.zeros_like(v) for k, v in gst.items()}
+            ),
+            n_b=zeros_like_vary(jnp.zeros((), jnp.float32)),
+        )
+
+        def slot_b_feed(m_b):
+            return jnp.clip(m_b, 0, m - 1)
+
+        def tick(carry, t):
+            # ---------------- forward slot: microbatch t - stage ----------
+            m_f = t - stage_idx
+            f_valid = jnp.logical_and(m_f >= 0, m_f < m)
+            f_validf = f_valid.astype(jnp.float32)
+            feed = jax.lax.dynamic_index_in_dim(
+                x_feed, jnp.clip(m_f, 0, m - 1), keepdims=False
+            )
+            x_in = jnp.where(stage_idx == 0, feed, carry['x_f'])
+            x_in = x_in * f_validf.astype(x_in.dtype)
+            y = self.stage.apply({'params': sp}, x_in)
+            y = y * f_validf.astype(y.dtype)
+            # store the stage input for the backward recompute
+            slot_f = jnp.clip(m_f, 0, m - 1) % ring
+            resid = jax.lax.dynamic_update_index_in_dim(
+                carry['resid'],
+                jnp.where(f_valid, x_in, jax.lax.dynamic_index_in_dim(
+                    carry['resid'], slot_f, keepdims=False)),
+                slot_f, 0,
+            )
+
+            # last stage: head + loss + cotangent for this microbatch, the
+            # same tick its forward completes (the 1F1B pivot). Other
+            # stages skip the head entirely — they are off the tick's
+            # critical path while the last stage computes it.
+            tgt = jax.lax.dynamic_index_in_dim(
+                t_feed, jnp.clip(m_f, 0, m - 1), keepdims=False
+            )
+
+            def do_head(_):
+                lval, pull = jax.vjp(head_loss, y, head_params, lnf_params, tgt)
+                ybar, hbar, lbar, _ = pull(f_validf)
+                return lval * f_validf, ybar, hbar, lbar
+
+            def no_head(_):
+                # fresh zeros are unvarying; pcast so both branches agree
+                return jax.tree_util.tree_map(
+                    lambda v: jax.lax.pcast(
+                        jnp.zeros(v.shape, v.dtype), all_axes, to='varying'
+                    ),
+                    (
+                        jnp.zeros((), jnp.float32),
+                        jnp.zeros_like(y),
+                        jax.tree_util.tree_map(
+                            lambda v: jnp.zeros_like(v, jnp.float32),
+                            head_params,
+                        ),
+                        jax.tree_util.tree_map(
+                            lambda v: jnp.zeros_like(v, jnp.float32),
+                            lnf_params,
+                        ),
+                    ),
+                )
+
+            lval, ybar_local, hbar, lbar = jax.lax.cond(
+                stage_idx == n - 1, do_head, no_head, None
+            )
+
+            # ---------------- backward slot: microbatch t - (2S-2-stage) --
+            m_b = t - (2 * n - 2 - stage_idx)
+            b_valid = jnp.logical_and(m_b >= 0, m_b < m)
+            b_validf = b_valid.astype(jnp.float32)
+            slot_b = jnp.clip(m_b, 0, m - 1) % ring
+            x_saved = jax.lax.dynamic_index_in_dim(resid, slot_b, keepdims=False)
+            # cotangent: in-tick on the last stage (m_b == m_f there), the
+            # ppermuted one from stage s+1 elsewhere
+            ybar = jnp.where(stage_idx == n - 1, ybar_local, carry['g_b'])
+            ybar = ybar * b_validf.astype(ybar.dtype)
+            y_re, pull, tick_a = jax.vjp(
+                lambda sp_, x_, gd_: self._stage_apply_captured(
+                    sp_, gd_, x_, b_validf
+                ),
+                sp, x_saved, gst, has_aux=True,
+            )
+            del y_re
+            spbar, xbar_mb, gdbar = pull(ybar)
+
+            carry = dict(
+                x_f=jax.lax.ppermute(y, PIPE_AXIS, fwd_perm),
+                g_b=jax.lax.ppermute(
+                    xbar_mb.astype(self.dtype), PIPE_AXIS, bwd_perm
+                ),
+                resid=resid,
+                xbar=jax.lax.dynamic_update_index_in_dim(
+                    carry['xbar'],
+                    jnp.where(
+                        jnp.logical_and(stage_idx == 0, b_valid),
+                        xbar_mb.astype(self.dtype),
+                        jax.lax.dynamic_index_in_dim(
+                            carry['xbar'], slot_b_feed(m_b), keepdims=False
+                        ),
+                    ),
+                    slot_b_feed(m_b), 0,
+                ),
+                loss=carry['loss'] + lval,
+                sgrads=jax.tree_util.tree_map(
+                    lambda acc, new: acc + new, carry['sgrads'], spbar
+                ),
+                hgrads=jax.tree_util.tree_map(
+                    lambda acc, new: acc + new, carry['hgrads'], hbar
+                ),
+                lgrads=jax.tree_util.tree_map(
+                    lambda acc, new: acc + new, carry['lgrads'], lbar
+                ),
+                a_acc={k: carry['a_acc'][k] + tick_a[k] for k in tick_a},
+                g_acc={k: carry['g_acc'][k] + gdbar[k] for k in gdbar},
+                n_b=carry['n_b'] + b_validf,
+            )
+            return carry, None
+
+        carry, _ = jax.lax.scan(tick, carry0, jnp.arange(ticks))
+
+        loss_sum = jax.lax.psum(carry['loss'], all_axes)
+        sgrads = carry['sgrads']
+        hgrads = jax.tree_util.tree_map(
+            lambda v: jax.lax.psum(v, all_axes), carry['hgrads']
+        )
+        lgrads = jax.tree_util.tree_map(
+            lambda v: jax.lax.psum(v, all_axes), carry['lgrads']
+        )
+        a_acc, g_acc, n_b = carry['a_acc'], carry['g_acc'], carry['n_b']
+        if self.data_axes:
+            # DP reductions: stage grads and factor stats sum over the data
+            # peers (the reference's factor allreduce over the DP group)
+            sgrads = jax.tree_util.tree_map(
+                lambda v: jax.lax.psum(v, self.data_axes), sgrads
+            )
+            a_acc = {
+                k: jax.lax.psum(v, self.data_axes) for k, v in a_acc.items()
+            }
+            g_acc = {
+                k: jax.lax.psum(v, self.data_axes) for k, v in g_acc.items()
+            }
+            n_b = jax.lax.psum(n_b, self.data_axes)
+        ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        # xbar holds real cotangents on stage 0 and zeros elsewhere: the
+        # psum over pipe is the broadcast of stage 0's buffer to the world
+        xbar = jax.lax.psum(carry['xbar'], PIPE_AXIS)
+        return (
+            loss_sum,
+            ex(sgrads),
+            hgrads,
+            lgrads,
+            ex(a_acc),
+            ex(g_acc),
+            n_b[None],
+            xbar,
+        )
+
+    def _loss_and_stats_1f1b(self, params, batch):
+        """1F1B: loss, grads, and capture stats from ONE combined scan."""
+        tokens, targets = batch
+        b, s = tokens.shape
+        m = self.n_microbatches
+        self._validate_batch(b)
+        gstats0 = self.zero_gstats()
+
+        def embed_fn(ep):
+            x = self._embed({'embed': ep['embed'],
+                             'pos_embed': ep['pos_embed']}, tokens)
+            return x.reshape(m, b // m, s, self.d_model)
+
+        epar = {'embed': params['embed'], 'pos_embed': params['pos_embed']}
+        x_feed, embed_pull = jax.vjp(embed_fn, epar)
+        t_feed = targets.reshape(m, b // m, s)
+
+        gspec = {k: P(PIPE_AXIS) for k in gstats0}
+        bspec = P(None, self.data_axes) if self.data_axes else P()
+        tspec = bspec
+        out = jax.shard_map(
+            self._body_1f1b,
+            mesh=self.mesh,
+            in_specs=(P(PIPE_AXIS), P(), P(), bspec, tspec, gspec),
+            out_specs=(
+                P(),                # loss (psum'd)
+                jax.tree_util.tree_map(lambda _: P(PIPE_AXIS),
+                                       params['stages']),
+                P(),                # head grads (psum'd)
+                P(),                # ln_f grads (psum'd)
+                {k: P(PIPE_AXIS) for k in gstats0},
+                {k: P(PIPE_AXIS) for k in gstats0},
+                P(PIPE_AXIS),       # counts
+                bspec,              # xbar feed
+            ),
+        )(params['stages'], params['head'], params['ln_f'], x_feed, t_feed,
+          gstats0)
+        loss, sgrads, hgrads, lgrads, a_stats, g_stats, counts, xbar = out
+        (egrads,) = embed_pull(xbar)
+        grads = {
+            'embed': egrads['embed'],
+            'pos_embed': egrads['pos_embed'],
+            'stages': sgrads,
+            'head': hgrads,
+            'ln_f': lgrads,
+        }
+        denom = jnp.maximum(counts, 1.0)
+        a_avg = {k: v / denom[:, None, None] for k, v in a_stats.items()}
+        # g-tap cotangents carry the 1/total_tokens loss normalization; the
+        # per-count division matches the gpipe path's convention
+        g_avg = {k: v / denom[:, None, None] for k, v in g_stats.items()}
+        return loss, grads, capture_lib.CapturedStats(a=a_avg, g=g_avg)
+
     # ------------------------------------------------------------- loss
 
     def loss_and_stats(self, params, batch):
         """(loss, grads, stage-stacked stats) in one backward pass."""
+        if self.schedule == '1f1b':
+            return self._loss_and_stats_1f1b(params, batch)
 
         def tapped(params, gstats):
             tokens, targets = batch
