@@ -26,43 +26,41 @@ NEG_INF = -1e30
 
 
 def dense_causal_attention(q, k, v):
-    """Reference single-device attention: (B, S, H, D) -> (B, S, H, D)."""
-    scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum(
-        'bqhd,bkhd->bhqk', q * scale, k, preferred_element_type=jnp.float32
-    )
-    s = q.shape[1]
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    logits = jnp.where(mask, logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum(
-        'bhqk,bkhd->bqhd', probs, v, preferred_element_type=jnp.float32
-    )
+    """Single-device causal attention: (B, S, H, D) -> (B, S, H, D).
+
+    On TPU with tile-aligned shapes this dispatches to the Pallas flash
+    kernel (ops/pallas_attention): scores stay in VMEM and above-diagonal
+    K tiles are skipped. Elsewhere the dense einsum path runs.
+    """
+    from kfac_tpu.ops import pallas_attention as pa
+
+    if pa.use_flash_for(
+        q.shape[1], k.shape[1], q.shape[-1], q.dtype.itemsize
+    ):
+        out = _finish(pa.flash_attention_partials(q, k, v, causal=True))
+        return out.astype(q.dtype)
+    out = _finish(pa.attend_partials_einsum(q, k, v, 0, 0, True))
     return out.astype(q.dtype)
 
 
 def _block_attend(q, k, v, q_offset, k_offset, causal):
-    """Unnormalized blockwise attention: returns (acc, row_max, row_sum)."""
-    scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum(
-        'bqhd,bkhd->bhqk', q * scale, k, preferred_element_type=jnp.float32
-    )
-    if causal:
-        sq, sk = q.shape[1], k.shape[1]
-        q_pos = q_offset + jnp.arange(sq)
-        k_pos = k_offset + jnp.arange(sk)
-        mask = q_pos[:, None] >= k_pos[None, :]
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
-    m = jnp.max(logits, axis=-1)  # (B,H,Q)
-    p = jnp.exp(logits - m[..., None])
-    # fully-masked rows: exp(NEG_INF - NEG_INF) = 1 would poison the sum
-    p = jnp.where(logits <= NEG_INF / 2, 0.0, p)
-    l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum(
-        'bhqk,bkhd->bqhd', p.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )
-    return acc, m, l
+    """Unnormalized blockwise attention: returns (acc, row_max, row_sum).
+
+    On TPU with tile-aligned chunks the Pallas flash kernel computes the
+    partials (global offsets flow in as scalar prefetch, so causal tile
+    skipping tracks the ring position); elsewhere the einsum
+    implementation runs (ops/pallas_attention.attend_partials_einsum —
+    also the kernel's backward and interpret-mode oracle).
+    """
+    from kfac_tpu.ops import pallas_attention as pa
+
+    if pa.use_flash_for(
+        q.shape[1], k.shape[1], q.shape[-1], q.dtype.itemsize
+    ):
+        return pa.flash_attention_partials(
+            q, k, v, q_offset=q_offset, k_offset=k_offset, causal=causal
+        )
+    return pa.attend_partials_einsum(q, k, v, q_offset, k_offset, causal)
 
 
 def _merge(carry, blk):
