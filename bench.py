@@ -234,8 +234,10 @@ def _run(result: dict) -> None:
         batch, seq, d_model, layers, vocab = 4, 128, 128, 2, 512
         dtype = jnp.float32
 
+    # 4 heads -> head_dim 128: lane-aligned for the Pallas flash-attention
+    # kernel (ops/pallas_attention dispatches on d % 128 == 0)
     model = TransformerLM(
-        vocab_size=vocab, d_model=d_model, num_heads=8, num_layers=layers,
+        vocab_size=vocab, d_model=d_model, num_heads=4, num_layers=layers,
         max_len=seq, dtype=dtype,
     )
     key = jax.random.PRNGKey(0)

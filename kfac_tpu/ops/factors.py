@@ -118,6 +118,21 @@ def newton_schulz_inverse(
     return x.astype(inv_dtype)
 
 
+def damped_inverse(
+    factor: jax.Array,
+    damping: float | jax.Array,
+    inv_dtype: jnp.dtype = jnp.float32,
+    solver: str = 'cholesky',
+    iters: int = 30,
+) -> jax.Array:
+    """Solver-dispatched damped inverse — the single place the
+    ``inverse_solver`` config option is interpreted (dense, KAISA, and
+    pipeline engines all call this)."""
+    if solver == 'newton_schulz':
+        return newton_schulz_inverse(factor, damping, inv_dtype, iters=iters)
+    return compute_inverse(factor, damping, inv_dtype)
+
+
 def eigen_preconditioned_grad(
     grad: jax.Array,
     a: EigenDecomp,

@@ -429,21 +429,13 @@ class DistributedKFAC:
         return q, d
 
     def _sharded_inv(self, stack: jax.Array, damping) -> jax.Array:
-        if self.config.inverse_solver == 'newton_schulz':
-            def local(block):
-                return jax.vmap(
-                    lambda m: factors_lib.newton_schulz_inverse(
-                        m, damping, jnp.float32,
-                        iters=self.config.newton_schulz_iters,
-                    )
-                )(block)
-        else:
-            def local(block):
-                f = block.astype(jnp.float32)
-                eye = jnp.eye(f.shape[-1], dtype=f.dtype)
-                fd = f + damping * eye
-                return jax.vmap(lambda m: jax.scipy.linalg.cho_solve(
-                    jax.scipy.linalg.cho_factor(m), eye))(fd)
+        def local(block):
+            return jax.vmap(
+                lambda m: factors_lib.damped_inverse(
+                    m, damping, jnp.float32, self.config.inverse_solver,
+                    self.config.newton_schulz_iters,
+                )
+            )(block)
 
         spec = P(self.all_axes)
         return jax.shard_map(

@@ -714,10 +714,15 @@ class PipelineKFAC:
     """K-FAC for a :class:`PipelinedLM`'s stage layers.
 
     State arrays keep the leading stage axis sharded over ``pipe``: factor
-    updates, eigendecompositions, and preconditioning all run inside one
+    updates, decompositions, and preconditioning all run inside one
     shard_map with zero cross-stage traffic (the reference's
     MEM-OPT-among-pipe-peers, kfac/gpt_neox/assignment.py:116-130). The
     kl-clip sum is the only cross-stage collective (one psum).
+
+    Both compute methods are supported: EIGEN (eigendecompositions in the
+    ``qa/qg/da/dg`` slots) and INVERSE (damped inverses in ``qa/qg``,
+    solver per ``config.inverse_solver`` — ``'newton_schulz'`` keeps
+    pipelined K-FAC entirely matmul-based on TPU).
     """
 
     config: KFACPreconditioner
@@ -740,10 +745,7 @@ class PipelineKFAC:
         self._dp_size = 1
         for ax in self._dp_axes:
             self._dp_size *= int(self.mesh.shape[ax])
-        if self.config.compute_method != enums.ComputeMethod.EIGEN:
-            raise NotImplementedError(
-                'PipelineKFAC supports only the EIGEN compute method'
-            )
+        self._eigen = self.config.compute_method == enums.ComputeMethod.EIGEN
         if self.config.prediv_eigenvalues:
             raise NotImplementedError(
                 'prediv_eigenvalues is not supported by PipelineKFAC'
@@ -836,6 +838,21 @@ class PipelineKFAC:
                     gdec = factors_lib.compute_eigh(ng_, cfg.inv_dtype)
                     return adec.q, gdec.q, adec.d, gdec.d
 
+                def run_inverse(_):
+                    # INVERSE method: qa/qg slots hold the damped inverses
+                    # (da/dg stay zero). Solver per config — Newton-Schulz
+                    # keeps pipelined K-FAC eigh/cholesky-free on TPU.
+                    inv = lambda f: factors_lib.damped_inverse(
+                        f, damping, cfg.inv_dtype, cfg.inverse_solver,
+                        cfg.newton_schulz_iters,
+                    )
+                    return (
+                        inv(na_), inv(ng_),
+                        jnp.zeros_like(da[name]), jnp.zeros_like(dg[name]),
+                    )
+
+                run_decomp = run_eigh if self._eigen else run_inverse
+
                 if self._dp_axes:
                     # round-robin this layer's eigh over the DP peers of the
                     # stage, then psum-share: eigh wall-clock divides by dp
@@ -850,7 +867,7 @@ class PipelineKFAC:
                     def dp_compute(_):
                         out = jax.lax.cond(
                             self._peer_index() == owner,
-                            lambda _: tuple(map(vary, run_eigh(None))),
+                            lambda _: tuple(map(vary, run_decomp(None))),
                             lambda _: tuple(
                                 map(
                                     vary,
@@ -870,7 +887,7 @@ class PipelineKFAC:
 
                     compute = dp_compute
                 else:
-                    compute = run_eigh
+                    compute = run_decomp
 
                 qa_, qg_, da_, dg_ = jax.lax.cond(
                     do_inverses,
@@ -886,12 +903,17 @@ class PipelineKFAC:
                 for k in path:
                     node = node[k]
                 gmat = h.grads_to_matrix(dict(node))
-                pmat = factors_lib.eigen_preconditioned_grad(
-                    gmat,
-                    factors_lib.EigenDecomp(qa_, da_),
-                    factors_lib.EigenDecomp(qg_, dg_),
-                    damping,
-                )
+                if self._eigen:
+                    pmat = factors_lib.eigen_preconditioned_grad(
+                        gmat,
+                        factors_lib.EigenDecomp(qa_, da_),
+                        factors_lib.EigenDecomp(qg_, dg_),
+                        damping,
+                    )
+                else:
+                    pmat = factors_lib.inverse_preconditioned_grad(
+                        gmat, qa_, qg_
+                    )
                 if cfg.kl_clip is not None:
                     vg = vg + jnp.sum(
                         pmat.astype(jnp.float32) * gmat.astype(jnp.float32)

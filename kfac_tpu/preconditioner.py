@@ -267,14 +267,10 @@ class KFACPreconditioner:
                 else:
                     da[name], dg[name] = adec.d, gdec.d
             return state._replace(qa=qa, qg=qg, da=da, dg=dg, dgda=dgda)
-        if self.inverse_solver == 'newton_schulz':
-            inv = lambda f: factors_lib.newton_schulz_inverse(
-                f, damping, self.inv_dtype, iters=self.newton_schulz_iters
-            )
-        else:
-            inv = lambda f: factors_lib.compute_inverse(
-                f, damping, self.inv_dtype
-            )
+        inv = lambda f: factors_lib.damped_inverse(
+            f, damping, self.inv_dtype, self.inverse_solver,
+            self.newton_schulz_iters,
+        )
         a_inv = {n: inv(state.a[n]) for n in state.a}
         g_inv = {n: inv(state.g[n]) for n in state.g}
         return state._replace(a_inv=a_inv, g_inv=g_inv)

@@ -302,3 +302,43 @@ def test_1f1b_rejects_unknown_schedule():
             mesh=_mesh(2), vocab_size=64, d_model=32, num_heads=4,
             num_layers=2, schedule='2f2b',
         )
+
+
+def test_pipeline_inverse_method_matches_eigen():
+    """INVERSE (Newton-Schulz) and EIGEN solve the same damped Kronecker
+    system, so pipelined training trajectories coincide."""
+    def run(**cfg_kw):
+        model = _model(2, num_layers=2, micro=4)
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
+        targets = jnp.roll(tokens, -1, 1)
+        params = model.init(jax.random.PRNGKey(1))
+        cfg = kfac_tpu.KFACPreconditioner(
+            registry=model.stage_registry, damping=0.01, lr=0.1,
+            kl_clip=None, **cfg_kw,
+        )
+        pk = pipeline.PipelineKFAC(config=cfg, model=model)
+        state = pk.init()
+
+        @jax.jit
+        def train_step(params, state, batch):
+            loss, grads, stats = model.loss_and_stats(params, batch)
+            state, grads = pk.step(state, grads, stats)
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - 0.1 * g, params, grads
+            )
+            return params, state, loss
+
+        losses = []
+        for _ in range(5):
+            params, state, loss = train_step(
+                params, state, (tokens, targets)
+            )
+            losses.append(float(loss))
+        return losses
+
+    eig = run(compute_method='eigen')
+    inv = run(compute_method='inverse', inverse_solver='newton_schulz')
+    chol = run(compute_method='inverse')
+    assert all(np.isfinite(eig)) and eig[-1] < eig[0]
+    np.testing.assert_allclose(eig, inv, rtol=2e-3)
+    np.testing.assert_allclose(chol, inv, rtol=2e-3)

@@ -21,13 +21,25 @@ import jax.numpy as jnp
 
 
 def timeit(fn, *args, iters=20, warmup=1):
+    """Time fn with an INPUT-VARYING first argument each iteration.
+
+    The axon pool backend memoizes repeated identical computations
+    (measured: an 8-deep 4096^3 matmul chain 'ran' in 0.04 ms — 30x above
+    physical peak), so same-input timing loops report cache hits. Adding
+    an iteration-dependent epsilon to the first argument forces real
+    execution while perturbing the math negligibly.
+    """
+    first, rest = args[0], args[1:]
     out = None
-    for _ in range(warmup):
-        out = fn(*args)
+    # 1% scale survives bf16 rounding (additive 1e-6 would round away)
+    for i in range(warmup):
+        out = fn(first * (1.0 + 0.01 * (i + 1)), *rest)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
+    for i in range(iters):
+        # step must exceed bf16's spacing at 1.0 (2^-7) or adjacent
+        # iterations round to identical inputs and re-enable the cache
+        out = fn(first * (1.0 + 0.01 * (i + 1)), *rest)
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / iters
 
@@ -80,19 +92,50 @@ def main():
     flops = 8 * 2 * n**3
     report('matmul4096_bf16_chain8', t, tflops=round(flops / t / 1e12, 1))
 
+    # --- flash attention kernel vs einsum attention (TPU only: the
+    # kernel needs real Mosaic, and the einsum path at this size is
+    # minutes on CPU) ------------------------------------------------------
+    from kfac_tpu.models import attention as att
+    from kfac_tpu.ops import pallas_attention as pa
+
+    on_tpu = dev.platform == 'tpu'
+    b, s, h, hd = (4, 2048, 4, 128) if on_tpu else (1, 256, 1, 128)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(9), 3)
+    qkv = tuple(
+        jax.random.normal(kx, (b, s, h, hd), jnp.bfloat16)
+        for kx in (kq, kk, kv)
+    )
+    dense_att = jax.jit(
+        lambda q, k, v: att._finish(pa.attend_partials_einsum(q, k, v, 0, 0, True))
+    )
+    t = timeit(dense_att, *qkv, iters=args.iters)
+    report(f'attn_einsum_s{s}', t)
+    if on_tpu:
+        try:
+            flash = jax.jit(
+                lambda q, k, v: att._finish(
+                    pa.flash_attention_partials(q, k, v, causal=True)
+                )
+            )
+            t2 = timeit(flash, *qkv, iters=args.iters)
+            err = float(jnp.abs(
+                flash(*qkv).astype(jnp.float32)
+                - dense_att(*qkv).astype(jnp.float32)
+            ).max())
+            report(f'attn_flash_s{s}', t2, max_err=round(err, 5),
+                   speedup=round(t / t2, 2))
+        except Exception as exc:  # noqa: BLE001
+            report(f'attn_flash_s{s}', float('nan'),
+                   error=f'{type(exc).__name__}: {exc}')
+
     for d in args.sizes:
         m = jax.random.normal(jax.random.PRNGKey(d), (args.rows, d),
                               jnp.float32)
         cov = (m.T @ m) / args.rows  # SPD test matrix
 
-        # eigh: single and vmap-batched x4
         f = jax.jit(lambda c: jnp.linalg.eigh(c))
         t = timeit(f, cov, iters=max(3, args.iters // 4))
         report(f'eigh_{d}', t)
-        stack = jnp.broadcast_to(cov, (4, d, d))
-        fb = jax.jit(jax.vmap(jnp.linalg.eigh))
-        t4 = timeit(fb, stack, iters=max(3, args.iters // 4))
-        report(f'eigh_{d}_vmap4', t4, per_matrix_ms=round(t4 / 4 * 1e3, 3))
 
         # cholesky factor + solve against identity (the INVERSE method)
         def chol_inv(c):
