@@ -30,6 +30,40 @@ from kfac_tpu.parallel import tensor_parallel, token_sharding, train_mesh
 from kfac_tpu.parallel.mesh import SEQ_AXIS
 
 
+def _run_epochs(args, tokens_np, step_fn, start_epoch=0, on_epoch_end=None):
+    """Shared epoch/step loop: corpus windows, limit-steps, perplexity.
+
+    ``step_fn(xb, yb) -> loss`` advances whatever training state the caller
+    closes over; ``on_epoch_end(epoch)`` handles checkpoints.
+    """
+    timer = common.Timer()
+    final_ppl = float('inf')
+    for epoch in range(start_epoch, args.epochs):
+        lm = common.Metric()
+        for step, (xb, yb) in enumerate(
+            data.lm_batches(tokens_np, args.batch_size, args.seq_len,
+                            args.seed + epoch)
+        ):
+            if args.limit_steps and step >= args.limit_steps:
+                break
+            lm.update(float(step_fn(xb, yb)), xb.size)
+        final_ppl = float(np.exp(min(20.0, lm.avg)))
+        print(
+            f'epoch {epoch}: train_loss={lm.avg:.4f} ppl={final_ppl:.1f} '
+            f'elapsed={timer.elapsed():.1f}s'
+        )
+        if on_epoch_end is not None:
+            on_epoch_end(epoch)
+    return final_ppl
+
+
+def _steps_per_epoch(args, tokens_np) -> int:
+    steps = (len(tokens_np) - 1) // (args.seq_len * args.batch_size)
+    if args.limit_steps:
+        steps = min(steps, args.limit_steps)
+    return steps
+
+
 def main(argv=None) -> float:
     p = argparse.ArgumentParser(description='Transformer LM + K-FAC')
     p.add_argument('--d-model', type=int, default=256)
@@ -39,11 +73,24 @@ def main(argv=None) -> float:
     p.add_argument('--vocab-size', type=int, default=8192)
     p.add_argument('--model-shards', type=int, default=1)
     p.add_argument('--seq-shards', type=int, default=1)
+    p.add_argument(
+        '--pipeline-stages', type=int, default=0,
+        help='pipeline the transformer blocks over this many stages '
+        '(remaining devices become data-parallel peers); the reference '
+        'reaches this via kfac.gpt_neox + DeepSpeed pipeline configs',
+    )
+    p.add_argument('--pipeline-microbatches', type=int, default=4)
+    p.add_argument(
+        '--pipeline-schedule', choices=['gpipe', '1f1b'], default='1f1b'
+    )
     common.add_train_args(p)
     common.add_kfac_args(p)
     args = p.parse_args(argv)
 
     common.distributed_init()
+
+    if args.pipeline_stages:
+        return _pipeline_main(args)
 
     world = len(jax.devices())
     dp = world // (args.model_shards * args.seq_shards)
@@ -77,11 +124,9 @@ def main(argv=None) -> float:
     def loss_fn(params, model_state, batch):
         return loss(params, batch), model_state
 
-    steps_per_epoch = (len(tokens_np) - 1) // (args.seq_len * args.batch_size)
-    if args.limit_steps:
-        steps_per_epoch = min(steps_per_epoch, args.limit_steps)
     lr_sched = common.make_lr_schedule(
-        args.lr, steps_per_epoch, args.epochs, args.warmup_epochs, args.lr_decay
+        args.lr, _steps_per_epoch(args, tokens_np), args.epochs,
+        args.warmup_epochs, args.lr_decay,
     )
     kfac = common.build_kfac(args, registry, mesh=mesh, lr=lr_sched)
     optimizer = optax.chain(
@@ -101,30 +146,97 @@ def main(argv=None) -> float:
             trainer.resume(state)
 
     ts = token_sharding(mesh)
-    timer = common.Timer()
-    final_ppl = float('inf')
-    for epoch in range(start_epoch, args.epochs):
-        lm = common.Metric()
-        for step, (xb, yb) in enumerate(
-            data.lm_batches(tokens_np, args.batch_size, args.seq_len,
-                            args.seed + epoch)
-        ):
-            if args.limit_steps and step >= args.limit_steps:
-                break
-            batch = (
-                jax.device_put(jnp.asarray(xb), ts),
-                jax.device_put(jnp.asarray(yb), ts),
-            )
-            state, l = trainer.step(state, batch)
-            lm.update(l, xb.size)
-        final_ppl = float(np.exp(min(20.0, lm.avg)))
-        print(
-            f'epoch {epoch}: train_loss={lm.avg:.4f} ppl={final_ppl:.1f} '
-            f'elapsed={timer.elapsed():.1f}s'
+
+    def step_fn(xb, yb):
+        nonlocal state
+        batch = (
+            jax.device_put(jnp.asarray(xb), ts),
+            jax.device_put(jnp.asarray(yb), ts),
         )
+        state, l = trainer.step(state, batch)
+        return l
+
+    def on_epoch_end(epoch):
         if args.checkpoint_dir:
             common.save_checkpoint(args.checkpoint_dir, state, epoch)
-    return final_ppl
+
+    return _run_epochs(
+        args, tokens_np, step_fn, start_epoch=start_epoch,
+        on_epoch_end=on_epoch_end,
+    )
+
+
+def _pipeline_main(args) -> float:
+    """Pipeline-parallel training path (DP x PP on one mesh).
+
+    K-FAC state is stage-sharded (MEM-OPT among pipe peers); the 1F1B
+    schedule computes loss, grads, and curvature stats in one scan.
+    """
+    from kfac_tpu.parallel import PipelinedLM, PipelineKFAC
+    from kfac_tpu.parallel.mesh import pipeline_mesh
+
+    if args.model_shards > 1 or args.seq_shards > 1:
+        raise SystemExit(
+            '--pipeline-stages composes only with data parallelism; '
+            'combining it with --model-shards/--seq-shards is not supported'
+        )
+    if args.checkpoint_dir:
+        print(
+            'note: checkpointing is not wired for the pipeline path yet; '
+            'ignoring --checkpoint-dir'
+        )
+
+    pmesh = pipeline_mesh(n_stages=args.pipeline_stages)
+    tokens_np, vocab = data.lm_corpus(args.data_dir, args.vocab_size)
+    plm = PipelinedLM(
+        mesh=pmesh,
+        vocab_size=vocab,
+        d_model=args.d_model,
+        num_heads=args.num_heads,
+        num_layers=args.num_layers,
+        n_microbatches=args.pipeline_microbatches,
+        max_len=args.seq_len,
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        schedule=args.pipeline_schedule,
+        skip_layers=tuple(args.kfac_skip_layers),
+    )
+    params = plm.init(jax.random.PRNGKey(args.seed))
+    print(
+        f'pipeline: {args.pipeline_stages} stages x '
+        f'{dict(pmesh.shape)} mesh, {args.pipeline_microbatches} '
+        f'microbatches, schedule={args.pipeline_schedule}; '
+        f'{len(plm.stage_registry)} K-FAC layers per stage'
+    )
+
+    lr_sched = common.make_lr_schedule(
+        args.lr, _steps_per_epoch(args, tokens_np), args.epochs,
+        args.warmup_epochs, args.lr_decay,
+    )
+    cfg = common.build_kfac(args, plm.stage_registry, lr=lr_sched)
+    pk = PipelineKFAC(config=cfg, model=plm) if cfg is not None else None
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.sgd(lr_sched, momentum=args.momentum),
+    )
+    pstate = pk.init() if pk is not None else None
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def train_step(params, pstate, opt_state, batch):
+        loss, grads, stats = plm.loss_and_stats(params, batch)
+        if pk is not None:
+            pstate, grads = pk.step(pstate, grads, stats)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), pstate, opt_state, loss
+
+    def step_fn(xb, yb):
+        nonlocal params, pstate, opt_state
+        params, pstate, opt_state, l = train_step(
+            params, pstate, opt_state, (jnp.asarray(xb), jnp.asarray(yb))
+        )
+        return l
+
+    return _run_epochs(args, tokens_np, step_fn)
 
 
 if __name__ == '__main__':

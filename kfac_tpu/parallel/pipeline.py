@@ -103,6 +103,10 @@ class PipelinedLM:
     # out of the same scan: B slots recompute the stage forward under an
     # explicit jax.vjp with the capture interceptor + g-taps attached.
     schedule: str = 'gpipe'
+    # regex patterns excluding stage layers from K-FAC registration (same
+    # semantics as register_model's skip_layers; the reference's LM example
+    # skips attention projections this way)
+    skip_layers: tuple[str, ...] | None = None
 
     def __post_init__(self) -> None:
         import warnings as _warnings
@@ -137,7 +141,9 @@ class PipelinedLM:
         self.ln_f = nn.LayerNorm(dtype=jnp.float32, name='ln_f')
         # Registry of one stage's K-FAC layers (shapes identical per stage).
         x = jnp.zeros((1, 8, self.d_model), self.dtype)
-        self.stage_registry = registry_lib.register_model(self.stage, x)
+        self.stage_registry = registry_lib.register_model(
+            self.stage, x, skip_layers=list(self.skip_layers or []),
+        )
         self._gtaps = {
             name: capture_lib._make_gtap(h)
             for name, h in self.stage_registry.layers.items()
