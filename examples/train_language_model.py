@@ -180,12 +180,6 @@ def _pipeline_main(args) -> float:
             '--pipeline-stages composes only with data parallelism; '
             'combining it with --model-shards/--seq-shards is not supported'
         )
-    if args.checkpoint_dir:
-        print(
-            'note: checkpointing is not wired for the pipeline path yet; '
-            'ignoring --checkpoint-dir'
-        )
-
     pmesh = pipeline_mesh(n_stages=args.pipeline_stages)
     tokens_np, vocab = data.lm_corpus(args.data_dir, args.vocab_size)
     plm = PipelinedLM(
@@ -221,6 +215,25 @@ def _pipeline_main(args) -> float:
     pstate = pk.init() if pk is not None else None
     opt_state = optimizer.init(params)
 
+    start_epoch = 0
+    if args.resume and args.checkpoint_dir and pk is not None:
+        from kfac_tpu import checkpoint as ckpt_lib
+
+        found = common.latest_checkpoint(args.checkpoint_dir)
+        if found is not None:
+            path, epoch = found
+            pstate, extra = ckpt_lib.restore(
+                path + '/kfac', pk,
+                extra_template={
+                    'params': params,
+                    'opt_state': opt_state,
+                    'epoch': np.asarray(0, np.int32),
+                },
+            )
+            params, opt_state = extra['params'], extra['opt_state']
+            start_epoch = int(extra['epoch']) + 1
+            print(f'resumed from {path} (epoch {epoch})')
+
     @jax.jit
     def train_step(params, pstate, opt_state, batch):
         loss, grads, stats = plm.loss_and_stats(params, batch)
@@ -236,7 +249,25 @@ def _pipeline_main(args) -> float:
         )
         return l
 
-    return _run_epochs(args, tokens_np, step_fn)
+    def on_epoch_end(epoch):
+        if args.checkpoint_dir and pk is not None:
+            from kfac_tpu import checkpoint as ckpt_lib
+
+            path = common._epoch_dir(args.checkpoint_dir, epoch)
+            ckpt_lib.save(
+                path + '/kfac', pstate,
+                extra={
+                    'params': params,
+                    'opt_state': opt_state,
+                    'epoch': np.asarray(epoch, np.int32),
+                },
+            )
+            print(f'checkpoint written to {path}')
+
+    return _run_epochs(
+        args, tokens_np, step_fn, start_epoch=start_epoch,
+        on_epoch_end=on_epoch_end,
+    )
 
 
 if __name__ == '__main__':

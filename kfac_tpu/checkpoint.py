@@ -26,8 +26,25 @@ except Exception:  # pragma: no cover - orbax is in the image; belt+braces
 
 
 def durable_state(state: Any) -> dict[str, Any]:
-    """The persistent slice of a K-FAC state: step + factors only."""
+    """The persistent slice of a K-FAC state: step + factors only.
+
+    Works for the NamedTuple states of the dense/KAISA engines and the
+    dict state of :class:`kfac_tpu.parallel.PipelineKFAC`.
+    """
+    if isinstance(state, dict):
+        return {'step': state['step'], 'a': state['a'], 'g': state['g']}
     return {'step': state.step, 'a': state.a, 'g': state.g}
+
+
+def _with_durable(state: Any, loaded: dict[str, Any]) -> Any:
+    if isinstance(state, dict):
+        return {
+            **state,
+            'step': loaded['step'], 'a': loaded['a'], 'g': loaded['g'],
+        }
+    return state._replace(
+        step=loaded['step'], a=loaded['a'], g=loaded['g']
+    )
 
 
 def save(path: str, state: Any, extra: dict[str, Any] | None = None) -> None:
@@ -62,10 +79,7 @@ def restore(
         template.update(extra_template)
     ckptr = ocp.StandardCheckpointer()
     payload = ckptr.restore(path, target=template)
-    loaded = payload['kfac']
-    state = template_state._replace(
-        step=loaded['step'], a=loaded['a'], g=loaded['g']
-    )
+    state = _with_durable(template_state, payload['kfac'])
     state = engine.rematerialize(state)
     extra = {k: v for k, v in payload.items() if k != 'kfac'}
     return state, extra

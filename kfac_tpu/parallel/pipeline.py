@@ -764,6 +764,92 @@ class PipelineKFAC:
             idx = idx * int(self.mesh.shape[ax]) + jax.lax.axis_index(ax)
         return idx
 
+    def _make_decomp(self, damping, a_mat, g_mat, like, li):
+        """Decomposition of one stage-local layer (inside shard_map).
+
+        Returns ``compute(operand) -> (qa, qg, da, dg)``: eigendecomposition
+        (EIGEN) or damped inverses in the qa/qg slots (INVERSE — the
+        Newton-Schulz solver keeps this matmul-only on TPU). With DP peers
+        present the work round-robins over them by layer index ``li`` and
+        psum-shares, dividing decomposition wall-clock by the DP world.
+        ``like`` supplies zero templates for the non-owner branch.
+        """
+        cfg = self.config
+
+        def run_eigh(_):
+            adec = factors_lib.compute_eigh(a_mat, cfg.inv_dtype)
+            gdec = factors_lib.compute_eigh(g_mat, cfg.inv_dtype)
+            return adec.q, gdec.q, adec.d, gdec.d
+
+        def run_inverse(_):
+            inv = lambda f: factors_lib.damped_inverse(
+                f, damping, cfg.inv_dtype, cfg.inverse_solver,
+                cfg.newton_schulz_iters,
+            )
+            return (
+                inv(a_mat), inv(g_mat),
+                jnp.zeros_like(like[2]), jnp.zeros_like(like[3]),
+            )
+
+        run_decomp = run_eigh if self._eigen else run_inverse
+        if not self._dp_axes:
+            return run_decomp
+        owner = li % self._dp_size
+
+        def vary(t):
+            return jax.lax.pcast(t, self._dp_axes, to='varying')
+
+        def dp_compute(_):
+            out = jax.lax.cond(
+                self._peer_index() == owner,
+                lambda _: tuple(map(vary, run_decomp(None))),
+                lambda _: tuple(
+                    vary(jnp.zeros_like(t)) for t in like
+                ),
+                None,
+            )
+            return tuple(jax.lax.psum(t, self._dp_axes) for t in out)
+
+        return dp_compute
+
+    def rematerialize(self, state):
+        """Recompute all decompositions from the stored factors (used by
+        checkpoint restore: only step + factors are durable)."""
+        cfg = self.config
+        damping = _resolve(cfg.damping, state['step'])
+        names = list(self.registry.layers)
+
+        def body(a, g, qa, qg, da, dg):
+            sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
+            a, g, qa, qg, da, dg = map(sq, (a, g, qa, qg, da, dg))
+            new_qa, new_qg, new_da, new_dg = {}, {}, {}, {}
+            for li, name in enumerate(names):
+                compute = self._make_decomp(
+                    damping, a[name], g[name],
+                    (qa[name], qg[name], da[name], dg[name]), li,
+                )
+                (
+                    new_qa[name], new_qg[name],
+                    new_da[name], new_dg[name],
+                ) = compute(None)
+            ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+            return ex(new_qa), ex(new_qg), ex(new_da), ex(new_dg)
+
+        specs = tuple({k: P(PIPE_AXIS) for k in names} for _ in range(6))
+        new_qa, new_qg, new_da, new_dg = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=specs,
+            out_specs=specs[:4],
+        )(
+            state['a'], state['g'], state['qa'], state['qg'],
+            state['da'], state['dg'],
+        )
+        return {
+            **state,
+            'qa': new_qa, 'qg': new_qg, 'da': new_da, 'dg': new_dg,
+        }
+
     def _spec(self):
         return NamedSharding(self.mesh, P(PIPE_AXIS))
 
@@ -839,62 +925,10 @@ class PipelineKFAC:
                 )
                 new_a[name], new_g[name] = na_, ng_
 
-                def run_eigh(_):
-                    adec = factors_lib.compute_eigh(na_, cfg.inv_dtype)
-                    gdec = factors_lib.compute_eigh(ng_, cfg.inv_dtype)
-                    return adec.q, gdec.q, adec.d, gdec.d
-
-                def run_inverse(_):
-                    # INVERSE method: qa/qg slots hold the damped inverses
-                    # (da/dg stay zero). Solver per config — Newton-Schulz
-                    # keeps pipelined K-FAC eigh/cholesky-free on TPU.
-                    inv = lambda f: factors_lib.damped_inverse(
-                        f, damping, cfg.inv_dtype, cfg.inverse_solver,
-                        cfg.newton_schulz_iters,
-                    )
-                    return (
-                        inv(na_), inv(ng_),
-                        jnp.zeros_like(da[name]), jnp.zeros_like(dg[name]),
-                    )
-
-                run_decomp = run_eigh if self._eigen else run_inverse
-
-                if self._dp_axes:
-                    # round-robin this layer's eigh over the DP peers of the
-                    # stage, then psum-share: eigh wall-clock divides by dp
-                    # instead of every replica recomputing every layer
-                    owner = li % self._dp_size
-
-                    def vary(t):
-                        return jax.lax.pcast(
-                            t, self._dp_axes, to='varying'
-                        )
-
-                    def dp_compute(_):
-                        out = jax.lax.cond(
-                            self._peer_index() == owner,
-                            lambda _: tuple(map(vary, run_decomp(None))),
-                            lambda _: tuple(
-                                map(
-                                    vary,
-                                    (
-                                        jnp.zeros_like(qa[name]),
-                                        jnp.zeros_like(qg[name]),
-                                        jnp.zeros_like(da[name]),
-                                        jnp.zeros_like(dg[name]),
-                                    ),
-                                )
-                            ),
-                            None,
-                        )
-                        return tuple(
-                            jax.lax.psum(t, self._dp_axes) for t in out
-                        )
-
-                    compute = dp_compute
-                else:
-                    compute = run_decomp
-
+                compute = self._make_decomp(
+                    damping, na_, ng_,
+                    (qa[name], qg[name], da[name], dg[name]), li,
+                )
                 qa_, qg_, da_, dg_ = jax.lax.cond(
                     do_inverses,
                     compute,
