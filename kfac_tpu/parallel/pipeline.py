@@ -881,6 +881,14 @@ class PipelineKFAC:
             state[key] = {
                 k: jax.device_put(v, spec) for k, v in state[key].items()
             }
+        # `step` must live on the full pipe mesh (replicated), not a single
+        # device: leaving it unplaced commits it to device 0 and any jit over
+        # (params-on-mesh, state) fails with incompatible-devices. Restore
+        # inherits this placement because orbax restores each leaf onto the
+        # template sharding, and checkpoint.restore templates from init().
+        state['step'] = jax.device_put(
+            state['step'], NamedSharding(self.mesh, P())
+        )
         return state
 
     def step(self, state, grads, stats):
