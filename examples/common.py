@@ -38,7 +38,11 @@ def add_kfac_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument('--kfac-factor-decay', type=float, default=0.95)
     g.add_argument('--kfac-kl-clip', type=float, default=0.001)
     g.add_argument(
-        '--kfac-compute-method', choices=('eigen', 'inverse'), default='eigen'
+        '--kfac-compute-method',
+        choices=('auto', 'eigen', 'inverse'),
+        default='auto',
+        help='auto picks per platform: eigen off-TPU (reference default), '
+        'inverse+Newton-Schulz on TPU where eigh is pathological',
     )
     g.add_argument(
         '--kfac-strategy',
@@ -47,6 +51,20 @@ def add_kfac_args(parser: argparse.ArgumentParser) -> None:
         help='maps to grad_worker_fraction 1 / 1/world / 0.5',
     )
     g.add_argument('--kfac-skip-layers', nargs='*', default=[])
+    g.add_argument(
+        '--kfac-verbose', action='store_true',
+        help='print the registration/assignment dump at construction '
+        '(the reference logs this by default, kfac/preconditioner.py:264)',
+    )
+
+
+def add_metrics_args(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group('metrics')
+    g.add_argument(
+        '--metrics-csv', default=None,
+        help='append step,name,value rows here (TensorBoard-writer slot of '
+        'the reference vision engine, examples/vision/engine.py:106-113)',
+    )
 
 
 def add_train_args(parser: argparse.ArgumentParser) -> None:
@@ -120,6 +138,40 @@ def cross_entropy_loss(logits, labels, num_classes):
     ).mean()
 
 
+class MetricsWriter:
+    """Append-only CSV metrics log (one row per step/epoch event).
+
+    The TensorBoard-writer slot of the reference's vision engine
+    (examples/vision/engine.py:106-113) without the TensorBoard dependency:
+    rows are ``step,name,value`` so any notebook/pandas/TensorBoard-import
+    path can consume them. The file is flushed per write so a killed run
+    keeps its trail.
+    """
+
+    def __init__(self, path: str | None) -> None:
+        self._f = None
+        if path:
+            import os as _os
+
+            _os.makedirs(_os.path.dirname(path) or '.', exist_ok=True)
+            self._f = open(path, 'a', buffering=1)
+            if self._f.tell() == 0:
+                self._f.write('step,name,value\n')
+
+    def write(self, step: int, name: str, value) -> None:
+        if self._f is not None:
+            self._f.write(f'{step},{name},{float(value):.8g}\n')
+
+    def write_many(self, step: int, metrics: dict) -> None:
+        for name, value in metrics.items():
+            self.write(step, name, value)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
 class Metric:
     """Streaming average (the allreduce is implicit: metrics are computed on
     global arrays; reference examples/utils.py:66-89)."""
@@ -159,12 +211,21 @@ def build_kfac(args, registry, mesh=None, lr=None):
         factor_decay=args.kfac_factor_decay,
         kl_clip=args.kfac_kl_clip,
         lr=args.lr if lr is None else lr,
-        compute_method=args.kfac_compute_method,
+        compute_method=(
+            None
+            if args.kfac_compute_method == 'auto'
+            else args.kfac_compute_method
+        ),
     )
     if mesh is not None:
         from kfac_tpu.parallel import DistributedKFAC
 
-        return DistributedKFAC(config=cfg, mesh=mesh)
+        dk = DistributedKFAC(config=cfg, mesh=mesh)
+        if getattr(args, 'kfac_verbose', False):
+            print(dk.describe())
+        return dk
+    if getattr(args, 'kfac_verbose', False):
+        print(cfg.describe())
     return cfg
 
 

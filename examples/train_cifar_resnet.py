@@ -40,6 +40,7 @@ def main(argv=None) -> float:
     )
     common.add_train_args(p)
     common.add_kfac_args(p)
+    common.add_metrics_args(p)
     args = p.parse_args(argv)
 
     common.distributed_init()
@@ -113,6 +114,7 @@ def main(argv=None) -> float:
     )
 
     timer = common.Timer()
+    writer = common.MetricsWriter(args.metrics_csv)
     test_acc = 0.0
     for epoch in range(start_epoch, args.epochs):
         train_loss = common.Metric()
@@ -142,8 +144,14 @@ def main(argv=None) -> float:
             f'epoch {epoch}: train_loss={train_loss.avg:.4f} '
             f'test_acc={test_acc:.4f} elapsed={timer.elapsed():.1f}s'
         )
+        writer.write_many(
+            epoch,
+            {'train_loss': train_loss.avg, 'test_acc': test_acc,
+             'elapsed_s': timer.elapsed()},
+        )
         if args.checkpoint_dir:
             common.save_checkpoint(args.checkpoint_dir, state, epoch)
+    writer.close()
     return test_acc
 
 

@@ -35,6 +35,7 @@ def main(argv=None) -> float:
     )
     common.add_train_args(p)
     common.add_kfac_args(p)
+    common.add_metrics_args(p)
     args = p.parse_args(argv)
 
     common.distributed_init()
@@ -106,6 +107,7 @@ def main(argv=None) -> float:
     )
 
     acc_val = 0.0
+    writer = common.MetricsWriter(args.metrics_csv)
     for epoch in range(start_epoch, args.epochs):
         epoch_timer = common.Timer()
         train_loss = common.Metric()
@@ -140,8 +142,14 @@ def main(argv=None) -> float:
             f'epoch {epoch}: loss={train_loss.avg:.4f} acc={acc_val:.4f} '
             f'{imgs / max(train_secs, 1e-9):.1f} img/s'
         )
+        writer.write_many(
+            epoch,
+            {'train_loss': train_loss.avg, 'test_acc': acc_val,
+             'img_per_s': imgs / max(train_secs, 1e-9)},
+        )
         if args.checkpoint_dir:
             common.save_checkpoint(args.checkpoint_dir, state, epoch)
+    writer.close()
     return acc_val
 
 

@@ -8,7 +8,8 @@ checkpointing — built on pjit/shard_map collectives instead of
 torch.distributed.
 """
 
-from kfac_tpu import checkpoint, enums, hyperparams, tracing
+from kfac_tpu import checkpoint, enums, hyperparams, tracing, warnings
+from kfac_tpu.preconditioner import default_compute_method
 from kfac_tpu.enums import (
     AllreduceMethod,
     AssignmentStrategy,
@@ -35,8 +36,10 @@ __all__ = [
     'TrainState',
     'Trainer',
     'checkpoint',
+    'default_compute_method',
     'enums',
     'hyperparams',
     'register_model',
     'tracing',
+    'warnings',
 ]

@@ -78,7 +78,16 @@ def restore(
     if extra_template:
         template.update(extra_template)
     ckptr = ocp.StandardCheckpointer()
-    payload = ckptr.restore(path, target=template)
+    try:
+        payload = ckptr.restore(path, target=template)
+    except (ValueError, KeyError) as exc:
+        raise ValueError(
+            f'checkpoint at {path!r} does not match the engine state '
+            'layout. For DistributedKFAC the stacked bucket keys/shapes '
+            'depend on the config (notably bucket_granularity and '
+            'colocate_factors): restore with the SAME values the '
+            f'checkpoint was saved under. Original error: {exc}'
+        ) from exc
     state = _with_durable(template_state, payload['kfac'])
     state = engine.rematerialize(state)
     extra = {k: v for k, v in payload.items() if k != 'kfac'}

@@ -44,32 +44,90 @@ from kfac_tpu.parallel import mesh as mesh_lib
 from kfac_tpu.preconditioner import KFACPreconditioner, _resolve
 
 
+def size_class(d: int, granularity: int) -> int:
+    """Round a factor dimension up to its size class.
+
+    Execution-side load balancing for heterogeneous factor shapes: a
+    ResNet-50 has dozens of distinct conv factor dims, often 1-2 layers
+    each; bucketing by EXACT dims turns the inverse update into dozens of
+    sequential mostly-padding batched decompositions. Rounding dims into a
+    few classes collapses them so one batched decomposition spans layers of
+    different true sizes — the role the reference's greedy cost-model
+    assignment plays (kfac/assignment.py:227-319), solved shape-side for
+    XLA's static-shape world. Padding is mathematically exact: factors pad
+    with an identity block (decoupled eigenspace), gradients with zeros
+    (see ``pad_factor``/``pad_grad``).
+
+    ``granularity <= 1`` disables classing (exact dims). Dims below the
+    granularity round to the next power of two (>= 8) so tiny layers don't
+    pay a full-class decomposition; larger dims round to the next multiple
+    of the granularity (MXU-tile friendly).
+    """
+    if granularity <= 1 or d == 0:
+        return d
+    if d >= granularity:
+        return -(-d // granularity) * granularity
+    c = 8
+    while c < d:
+        c *= 2
+    return c
+
+
+def pad_factor(m: jax.Array, c: int) -> jax.Array:
+    """Embed a (d, d) factor into its (c, c) class slot, identity block in
+    the padding. blockdiag(A, I) has a decoupled unit eigenspace, and the
+    matching gradient rows/cols are zero, so eigen/inverse preconditioning
+    of the real block is unchanged (basis-invariance of matrix functions)."""
+    d = m.shape[0]
+    if d == c:
+        return m
+    out = jnp.zeros((c, c), m.dtype).at[:d, :d].set(m)
+    idx = jnp.arange(d, c)
+    return out.at[idx, idx].set(jnp.ones((c - d,), m.dtype))
+
+
+def pad_grad(m: jax.Array, cg: int, ca: int) -> jax.Array:
+    """Zero-pad a (dg, da) gradient matrix into its (cg, ca) class slot."""
+    if m.shape == (cg, ca):
+        return m
+    return jnp.zeros((cg, ca), m.dtype).at[: m.shape[0], : m.shape[1]].set(m)
+
+
 class Bucket(NamedTuple):
-    """Layers sharing factor shapes, stacked along a leading slot axis."""
+    """Layers sharing factor size classes, stacked along a leading slot
+    axis. ``da``/``dg`` are CLASS dims; ``dims`` carries each layer's true
+    (da, dg) for grad embedding/extraction."""
 
     key: str
     layers: tuple[str, ...]
     da: int
     dg: int
     padded: int  # slots incl. padding to a multiple of world size
+    dims: tuple[tuple[int, int], ...]
 
 
-def build_buckets(registry: registry_lib.Registry, world: int) -> list[Bucket]:
-    """Group registered layers by (A dim, G dim) and pad to the world size."""
-    groups: dict[tuple[int, int], list[str]] = {}
+def build_buckets(
+    registry: registry_lib.Registry, world: int, granularity: int = 128
+) -> list[Bucket]:
+    """Group registered layers by (A class, G class), pad to the world
+    size."""
+    groups: dict[tuple[int, int], list[tuple[str, int, int]]] = {}
     for name, h in registry.layers.items():
-        groups.setdefault((h.a_factor_shape[0], h.g_factor_shape[0]), []).append(name)
+        da, dg = h.a_factor_shape[0], h.g_factor_shape[0]
+        key = (size_class(da, granularity), size_class(dg, granularity))
+        groups.setdefault(key, []).append((name, da, dg))
     buckets = []
-    for (da, dg), names in sorted(groups.items()):
-        n = len(names)
+    for (ca, cg), rows in sorted(groups.items()):
+        n = len(rows)
         padded = -(-n // world) * world
         buckets.append(
             Bucket(
-                key=f'{da}x{dg}',
-                layers=tuple(names),
-                da=da,
-                dg=dg,
+                key=f'{ca}x{cg}',
+                layers=tuple(r[0] for r in rows),
+                da=ca,
+                dg=cg,
                 padded=padded,
+                dims=tuple((r[1], r[2]) for r in rows),
             )
         )
     return buckets
@@ -88,26 +146,32 @@ class StorageBucket(NamedTuple):
 
     key: str
     layers: tuple[str, ...]
-    d: int
+    d: int  # class dim
     padded: int
+    dims: tuple[int, ...]  # true per-layer dims
 
 
 def build_side_buckets(
-    registry: registry_lib.Registry, world: int, side: str
+    registry: registry_lib.Registry,
+    world: int,
+    side: str,
+    granularity: int = 128,
 ) -> list[StorageBucket]:
-    """Group layers by a single factor dimension (non-colocated storage)."""
-    groups: dict[int, list[str]] = {}
+    """Group layers by a single factor size class (non-colocated
+    storage)."""
+    groups: dict[int, list[tuple[str, int]]] = {}
     for name, h in registry.layers.items():
         d = h.a_factor_shape[0] if side == 'a' else h.g_factor_shape[0]
-        groups.setdefault(d, []).append(name)
+        groups.setdefault(size_class(d, granularity), []).append((name, d))
     return [
         StorageBucket(
-            key=f'{side}{d}',
-            layers=tuple(names),
-            d=d,
-            padded=-(-len(names) // world) * world,
+            key=f'{side}{c}',
+            layers=tuple(r[0] for r in rows),
+            d=c,
+            padded=-(-len(rows) // world) * world,
+            dims=tuple(r[1] for r in rows),
         )
-        for d, names in sorted(groups.items())
+        for c, rows in sorted(groups.items())
     ]
 
 
@@ -152,7 +216,12 @@ class DistributedKFAC:
         self.strategy = assignment_lib.strategy_for_fraction(
             self.world, self.grad_workers / self.world
         )
-        self.buckets = build_buckets(self.registry, self.total_devices)
+        self.granularity = int(
+            getattr(self.config, 'bucket_granularity', 128)
+        )
+        self.buckets = build_buckets(
+            self.registry, self.total_devices, self.granularity
+        )
         self.colocate = bool(self.config.colocate_factors)
         # Parity object: cost-model view of the placement for reporting and
         # for API compatibility with the reference's query surface (also
@@ -169,19 +238,25 @@ class DistributedKFAC:
         # can run on different devices (reference kfac/assignment.py:268-304).
         if self.colocate:
             self.a_store = [
-                StorageBucket(b.key, b.layers, b.da, b.padded)
+                StorageBucket(
+                    b.key, b.layers, b.da, b.padded,
+                    tuple(d[0] for d in b.dims),
+                )
                 for b in self.buckets
             ]
             self.g_store = [
-                StorageBucket(b.key, b.layers, b.dg, b.padded)
+                StorageBucket(
+                    b.key, b.layers, b.dg, b.padded,
+                    tuple(d[1] for d in b.dims),
+                )
                 for b in self.buckets
             ]
         else:
             self.a_store = build_side_buckets(
-                self.registry, self.total_devices, 'a'
+                self.registry, self.total_devices, 'a', self.granularity
             )
             self.g_store = build_side_buckets(
-                self.registry, self.total_devices, 'g'
+                self.registry, self.total_devices, 'g', self.granularity
             )
         self._a_slot = {
             n: (sb.key, i)
@@ -339,10 +414,20 @@ class DistributedKFAC:
                 r = []
                 for i, n in enumerate(sb.layers):
                     if n in side_stats:
-                        r.append(pin(side_stats[n].astype(cfg.factor_dtype)))
+                        # embed the true-dim statistic into its size-class
+                        # slot (identity padding — exact, see pad_factor)
+                        r.append(
+                            pad_factor(
+                                pin(
+                                    side_stats[n].astype(cfg.factor_dtype)
+                                ),
+                                sb.d,
+                            )
+                        )
                     else:
                         # state slices are factor-sharded — pin them too so
-                        # the stack never mixes shardings
+                        # the stack never mixes shardings (already
+                        # class-size)
                         r.append(pin(side_state[sb.key][i]))
                 rows[sb.key] = r
             return rows
@@ -526,9 +611,15 @@ class DistributedKFAC:
             # forces XLA's involuntary full rematerialization of the stack
             # (same pattern as _stack_stats)
             rows = [
-                jax.lax.with_sharding_constraint(
-                    self.registry.layers[n].grads_to_matrix(layer_grads[n]),
-                    rep,
+                pad_grad(
+                    jax.lax.with_sharding_constraint(
+                        self.registry.layers[n].grads_to_matrix(
+                            layer_grads[n]
+                        ),
+                        rep,
+                    ),
+                    b.dg,
+                    b.da,
                 )
                 for n in b.layers
             ]
@@ -610,7 +701,10 @@ class DistributedKFAC:
             for i, name in enumerate(b.layers):
                 helper = self.registry.layers[name]
                 ref_dtype = layer_grads[name][next(iter(layer_grads[name]))].dtype
-                out[name] = helper.matrix_to_grads(pstack[i].astype(ref_dtype))
+                dag, dgg = b.dims[i]
+                out[name] = helper.matrix_to_grads(
+                    pstack[i][:dgg, :dag].astype(ref_dtype)
+                )
         return registry_lib.merge_layer_grads(grads, out, self.registry)
 
     # ------------------------------------------------------------------ step
@@ -645,6 +739,34 @@ class DistributedKFAC:
         """Recompute decompositions from factors after a checkpoint restore
         (reference semantics: kfac/base_preconditioner.py:296-308)."""
         return self.update_inverses(state)
+
+    def describe(self) -> str:
+        """Registration + placement dump: the reference's construction-time
+        assignment logging (kfac/preconditioner.py:264-268,300) as a
+        pull-based table — strategy, bucket layout, and per-layer inverse
+        workers from the KAISA assignment."""
+        lines = [
+            f'DistributedKFAC: {len(self.registry.layers)} layers over '
+            f'{self.total_devices} devices '
+            f'(grid {self.grad_workers}x{mesh_lib.n_cols(self.mesh)}), '
+            f'strategy={self.strategy.name}, colocate={self.colocate}, '
+            f'method={self.config.compute_method.name}',
+            self.config.describe(),
+            'stat transport buckets (stacked batched decompositions):',
+        ]
+        for b in self.buckets:
+            lines.append(
+                f'  bucket da={b.da} dg={b.dg}: '
+                f'{len(b.layers)} layers, {b.padded} padded slots'
+            )
+        lines.append('inverse workers (KAISA greedy assignment):')
+        for layer in self.assignment.get_layers():
+            workers = {
+                f: self.assignment.inv_worker(layer, f)
+                for f in self.assignment.get_factors(layer)
+            }
+            lines.append(f'  {layer}: {workers}')
+        return '\n'.join(lines)
 
     def memory_usage(self, state: DistKFACState) -> dict[str, int]:
         """Per-device bytes by category, read from the ACTUAL shard layout.

@@ -27,11 +27,35 @@ import jax
 import jax.numpy as jnp
 
 from kfac_tpu import enums
+from kfac_tpu import warnings as kfac_warnings
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
 from kfac_tpu.ops import factors as factors_lib
 
 ScalarOrSchedule = float | Callable[[jax.Array], jax.Array | float]
+
+
+def default_compute_method(
+    platform: str | None = None,
+) -> tuple[enums.ComputeMethod, str]:
+    """Platform-appropriate ``(compute_method, inverse_solver)`` defaults.
+
+    The reference defaults to EIGEN everywhere
+    (kfac/preconditioner.py:245-256) because cuSOLVER makes eigh cheap on
+    GPU. On TPU, eigh/cholesky lower to sequential panel algorithms that are
+    MXU-hostile: a single distinct-shape EIGEN step was measured never to
+    finish compiling inside a 20-minute budget on v5e (see bench.py), while
+    the Newton-Schulz damped inverse is 2*iters large matmuls. So:
+
+    - ``tpu`` -> (INVERSE, ``'newton_schulz'``)
+    - anything else (cpu, gpu/cuSOLVER) -> (EIGEN, ``'cholesky'``), the
+      reference's default behavior.
+    """
+    if platform is None:
+        platform = jax.default_backend()
+    if platform == 'tpu':
+        return enums.ComputeMethod.INVERSE, 'newton_schulz'
+    return enums.ComputeMethod.EIGEN, 'cholesky'
 
 
 def _resolve(value: ScalarOrSchedule, step: jax.Array) -> jax.Array | float:
@@ -86,7 +110,12 @@ class KFACPreconditioner:
         factor_decay: EMA alpha (constant or schedule of step).
         kl_clip: KL clipping bound, or None to disable.
         lr: learning rate used in the KL-clip scale (constant or schedule).
-        compute_method: EIGEN (default) or INVERSE.
+        compute_method: EIGEN or INVERSE. Default (``None``) is selected per
+            platform by :func:`default_compute_method` — EIGEN off-TPU (the
+            reference's default, kfac/preconditioner.py:245-256) and
+            INVERSE+Newton-Schulz on TPU, where EIGEN is pathological.
+            Forcing EIGEN on a TPU backend raises
+            :class:`~kfac_tpu.warnings.TPUPerformanceWarning`.
         prediv_eigenvalues: precompute 1/(dg x da + damping) at inv time.
         factor_dtype / inv_dtype: storage dtypes (decomps always run fp32).
 
@@ -102,17 +131,27 @@ class KFACPreconditioner:
     factor_decay: ScalarOrSchedule = 0.95
     kl_clip: ScalarOrSchedule | None = 0.001
     lr: ScalarOrSchedule = 0.1
-    compute_method: enums.ComputeMethod = enums.ComputeMethod.EIGEN
+    compute_method: enums.ComputeMethod | str | None = None
     # INVERSE-method solver: 'cholesky' (direct, best off-TPU) or
     # 'newton_schulz' — matmul-only damped inversion
     # (ops/factors.newton_schulz_inverse), the TPU-native choice: on v5e a
     # single distinct-shape eigh/cholesky costs tens of seconds of compile
     # and ~140 ms/run at d=2048, while Newton-Schulz is 2*iters MXU matmuls.
-    inverse_solver: str = 'cholesky'
+    # None selects per platform (see default_compute_method).
+    inverse_solver: str | None = None
     newton_schulz_iters: int = 25
     prediv_eigenvalues: bool = False
     factor_dtype: Any = jnp.float32
     inv_dtype: Any = jnp.float32
+    # Size-class granularity for the distributed engine's factor buckets:
+    # dims round up to a class (next multiple of this, powers of two below
+    # it) so heterogeneous layer shapes (a ResNet's dozens of conv dims)
+    # collapse into a few batched decompositions instead of dozens of
+    # mostly-padding ones — the execution-side counterpart of the
+    # reference's greedy cost balancing (kfac/assignment.py:227-319).
+    # Padding is exact (identity-block factors, zero-block grads). 1
+    # disables classing. Ignored by the dense engine.
+    bucket_granularity: int = 128
     # Whether the distributed engine stores/decomposes a layer's A and G in
     # the same stack slot (same device). False buckets A and G factors
     # independently by dimension, so the two eigendecompositions of a large
@@ -139,6 +178,30 @@ class KFACPreconditioner:
                     f'unknown compute_method {self.compute_method!r}; '
                     f'expected one of {[m.name.lower() for m in enums.ComputeMethod]}'
                 ) from None
+        platform = jax.default_backend()
+        method_default, solver_default = default_compute_method(platform)
+        if self.compute_method is None:
+            self.compute_method = method_default
+        elif (
+            self.compute_method == enums.ComputeMethod.EIGEN
+            and platform == 'tpu'
+        ):
+            warnings.warn(
+                'compute_method=EIGEN on a TPU backend: eigh lowers to a '
+                'sequential panel algorithm whose compile alone was measured '
+                'in tens of minutes on v5e. The TPU-native path is '
+                "compute_method='inverse' with inverse_solver="
+                "'newton_schulz' (the default when compute_method is left "
+                'unset).',
+                kfac_warnings.TPUPerformanceWarning,
+                stacklevel=2,
+            )
+        if self.inverse_solver is None:
+            self.inverse_solver = (
+                solver_default
+                if self.compute_method == enums.ComputeMethod.INVERSE
+                else 'cholesky'
+            )
         if isinstance(self.allreduce_method, str):
             try:
                 self.allreduce_method = enums.AllreduceMethod[
@@ -383,6 +446,28 @@ class KFACPreconditioner:
         :class:`KFACState` should save ``step``/``a``/``g`` and call this.
         """
         return self.update_inverses(state)
+
+    def describe(self) -> str:
+        """Human-readable registration dump.
+
+        The reference logs every registered module and the k-fac options at
+        construction (kfac/preconditioner.py:264-268,300); here the dump is
+        pull-based (pure construction, no logging side effects) — print it
+        or hand it to your logger.
+        """
+        lines = [
+            f'KFACPreconditioner: {len(self.registry.layers)} registered '
+            f'layers, compute_method={self.compute_method.name}, '
+            f'inverse_solver={self.inverse_solver}',
+        ]
+        for name, h in self.registry.layers.items():
+            lines.append(
+                f'  {name}: {type(h).__name__} '
+                f'A={h.a_factor_shape[0]}x{h.a_factor_shape[0]} '
+                f'G={h.g_factor_shape[0]}x{h.g_factor_shape[0]}'
+                f'{" +bias" if h.has_bias else ""}'
+            )
+        return '\n'.join(lines)
 
     def memory_usage(self, state: KFACState) -> dict[str, int]:
         """Approximate bytes held per category (reference:

@@ -280,6 +280,108 @@ class Trainer:
         )
         return loss, new_ms, grads, stats
 
+    def _ensure_accum_jits(self) -> None:
+        if not hasattr(self, '_jit_grads_stats'):
+            self._jit_grads_stats = jax.jit(self._grads_and_stats)
+            self._jit_grads_only = jax.jit(
+                jax.value_and_grad(self.loss_fn, has_aux=True)
+            )
+            self._jit_apply_kfac = jax.jit(
+                self._apply_accumulated, static_argnames=('with_stats',)
+            )
+
+    # ------------------------------------------- incremental accumulation
+
+    def accumulate_microbatch(
+        self, state: TrainState, microbatch
+    ) -> jax.Array:
+        """Accumulate one micro-batch's gradients/statistics without
+        stepping; finish with :meth:`apply_accumulated` or discard with
+        :meth:`reset_batch`.
+
+        This is the incremental counterpart of :meth:`step_accumulate` for
+        loops that must be able to abandon a batch mid-accumulation — the
+        reference's AMP flow, where a grad-scaler overflow calls
+        ``reset_batch`` to drop the poisoned mini-step accumulation
+        (kfac/base_preconditioner.py:126-130, 384-387). Returns this
+        micro-batch's loss.
+        """
+        from kfac_tpu.layers import capture as capture_lib
+
+        if self.kfac is None:
+            raise ValueError('accumulation requires a kfac preconditioner')
+        self._sync_step_count(state)
+        self._ensure_accum_jits()
+        acc = getattr(self, '_accum', None)
+        if acc is None:
+            acc = self._accum = {
+                'grads': None, 'stats': None, 'loss': 0.0, 'count': 0,
+                'model_state': state.model_state,
+                'capture': self._capture_now(),
+            }
+        if acc['capture']:
+            loss, model_state, grads, stats = self._jit_grads_stats(
+                state.params, acc['model_state'], microbatch
+            )
+            acc['stats'] = capture_lib.accumulate_stats(acc['stats'], stats)
+        else:
+            (loss, model_state), grads = self._jit_grads_only(
+                state.params, acc['model_state'], microbatch
+            )
+        acc['model_state'] = model_state
+        acc['loss'] = acc['loss'] + loss
+        acc['grads'] = (
+            grads
+            if acc['grads'] is None
+            else jax.tree_util.tree_map(jnp_add, acc['grads'], grads)
+        )
+        acc['count'] += 1
+        return loss
+
+    def reset_batch(self) -> None:
+        """Discard the pending micro-batch accumulation.
+
+        The reference's ``BaseKFACPreconditioner.reset_batch``
+        (kfac/base_preconditioner.py:384-387): called when a gradient-scaler
+        overflow poisons the accumulated statistics/gradients mid-batch.
+        The next :meth:`accumulate_microbatch` starts a fresh accumulation;
+        the K-FAC step counter and factors are untouched.
+        """
+        self._accum = None
+
+    def apply_accumulated(
+        self, state: TrainState
+    ) -> tuple[TrainState, jax.Array]:
+        """Finish an incremental accumulation: average, precondition, step.
+
+        Equivalent to :meth:`step_accumulate` over the micro-batches fed to
+        :meth:`accumulate_microbatch` since the last reset/apply.
+        """
+        acc = getattr(self, '_accum', None)
+        if acc is None or acc['count'] == 0:
+            raise ValueError(
+                'no pending accumulation: call accumulate_microbatch first'
+            )
+        from kfac_tpu.layers import capture as capture_lib
+
+        n = acc['count']
+        grads_avg = jax.tree_util.tree_map(lambda g: g / n, acc['grads'])
+        stats_avg = (
+            capture_lib.average_stats(acc['stats'], n)
+            if acc['capture']
+            else None
+        )
+        new_state = self._jit_apply_kfac(
+            state._replace(model_state=acc['model_state']),
+            grads_avg,
+            stats_avg,
+            with_stats=acc['capture'],
+        )
+        loss = acc['loss'] / n
+        self._accum = None
+        self._step_count += 1
+        return new_state, loss
+
     def step_accumulate(
         self, state: TrainState, microbatches
     ) -> tuple[TrainState, jax.Array]:
@@ -293,48 +395,17 @@ class Trainer:
         Off the factor-update cadence, micro-batches run the no-capture
         forward (no covariance FLOPs), same as :meth:`step`.
         """
-        from kfac_tpu.layers import capture as capture_lib
-
         if self.kfac is None:
             raise ValueError('step_accumulate requires a kfac preconditioner')
-        self._sync_step_count(state)
-        if not hasattr(self, '_jit_grads_stats'):
-            self._jit_grads_stats = jax.jit(self._grads_and_stats)
-            self._jit_grads_only = jax.jit(
-                jax.value_and_grad(self.loss_fn, has_aux=True)
+        if getattr(self, '_accum', None) is not None:
+            raise ValueError(
+                'an incremental accumulation is pending: finish it with '
+                'apply_accumulated or drop it with reset_batch before '
+                'step_accumulate'
             )
-            self._jit_apply_kfac = jax.jit(
-                self._apply_accumulated, static_argnames=('with_stats',)
-            )
-        capture_now = self._capture_now()
-        n = len(microbatches)
-        grads_acc, stats_acc, loss_acc, model_state = None, None, 0.0, state.model_state
         for mb in microbatches:
-            if capture_now:
-                loss, model_state, grads, stats = self._jit_grads_stats(
-                    state.params, model_state, mb
-                )
-                stats_acc = capture_lib.accumulate_stats(stats_acc, stats)
-            else:
-                (loss, model_state), grads = self._jit_grads_only(
-                    state.params, model_state, mb
-                )
-            loss_acc = loss_acc + loss
-            grads_acc = (
-                grads
-                if grads_acc is None
-                else jax.tree_util.tree_map(jnp_add, grads_acc, grads)
-            )
-        grads_avg = jax.tree_util.tree_map(lambda g: g / n, grads_acc)
-        stats_avg = (
-            capture_lib.average_stats(stats_acc, n) if capture_now else None
-        )
-        new_state = self._jit_apply_kfac(
-            state._replace(model_state=model_state), grads_avg, stats_avg,
-            with_stats=capture_now,
-        )
-        self._step_count += 1
-        return new_state, loss_acc / n
+            self.accumulate_microbatch(state, mb)
+        return self.apply_accumulated(state)
 
     def step_accumulate_scan(
         self, state: TrainState, microbatches

@@ -5,3 +5,7 @@ from __future__ import annotations
 
 class ExperimentalFeatureWarning(Warning):
     """Feature is experimental and may change or underperform."""
+
+
+class TPUPerformanceWarning(Warning):
+    """Configuration known to be pathologically slow on TPU backends."""

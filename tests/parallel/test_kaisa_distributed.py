@@ -325,12 +325,13 @@ def test_colocate_factors_false_placement_and_numerics(method):
     )
     dk = DistributedKFAC(config=cfg, mesh=mesh)
 
-    # placement: A side groups all three layers (shared da=17) in ONE
-    # stack while G splits 16s from 4s — slots no longer pairwise aligned
-    assert [sb.key for sb in dk.a_store] == ['a17']
-    assert sorted(sb.key for sb in dk.g_store) == ['g16', 'g4']
-    assert dk._a_slot['r'] == ('a17', 2)
-    assert dk._g_slot['r'] == ('g4', 0)
+    # placement: A side groups all three layers (shared da=17, class 32)
+    # in ONE stack while G splits 16s from 4s (classes 16 and 8) — slots
+    # no longer pairwise aligned
+    assert [sb.key for sb in dk.a_store] == ['a32']
+    assert sorted(sb.key for sb in dk.g_store) == ['g16', 'g8']
+    assert dk._a_slot['r'] == ('a32', 2)
+    assert dk._g_slot['r'] == ('g8', 0)
     assert not dk.assignment.colocate_factors
 
     cap = kfac_tpu.CurvatureCapture(reg)
@@ -342,8 +343,8 @@ def test_colocate_factors_false_placement_and_numerics(method):
     ref_state, ref_grads = ref_cfg.step(ref_cfg.init(), grads, stats)
 
     state = dk.init()
-    assert set(state.a) == {'a17'}
-    assert set(state.g) == {'g16', 'g4'}
+    assert set(state.a) == {'a32'}
+    assert set(state.g) == {'g16', 'g8'}
 
     @jax.jit
     def dstep(state, grads, stats):
@@ -424,4 +425,65 @@ def test_newton_schulz_solver_matches_cholesky_distributed():
             np.asarray(ns_grads[name]['kernel']),
             np.asarray(chol_grads[name]['kernel']),
             rtol=5e-3, atol=5e-5,
+        )
+
+
+def test_size_classes_collapse_heterogeneous_shapes_exactly():
+    """Heterogeneous factor dims collapse into few class buckets (the
+    execution-side load balancing of the reference's greedy assignment,
+    kfac/assignment.py:227-319) and the identity/zero padding is EXACT:
+    preconditioned grads match a granularity=1 (exact-dims) run."""
+    import flax.linen as nn
+
+    from kfac_tpu.parallel.kaisa import size_class
+
+    # classing rules: powers of two below the granularity, multiples above
+    assert size_class(7, 128) == 8
+    assert size_class(8, 128) == 8
+    assert size_class(100, 128) == 128
+    assert size_class(129, 128) == 256
+    assert size_class(513, 256) == 768
+    assert size_class(513, 1) == 513  # disabled
+
+    class Hetero(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(19, name='l0')(x))
+            x = nn.relu(nn.Dense(23, name='l1')(x))
+            x = nn.relu(nn.Dense(21, name='l2')(x))
+            return nn.Dense(5, name='l3')(x)
+
+    m = Hetero()
+    x = jax.random.normal(jax.random.PRNGKey(0), (WORLD * 4, 13))
+    y = jax.random.normal(jax.random.PRNGKey(1), (WORLD * 4, 5))
+    params = m.init(jax.random.PRNGKey(2), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        return jnp.mean((m.apply({'params': params}, xb) - yb) ** 2)
+
+    mesh = kaisa_mesh(grad_worker_fraction=1.0)
+    cap = kfac_tpu.CurvatureCapture(reg)
+    (_, _), grads, stats = cap.value_stats_and_grad(loss_fn)(params, (x, y))
+
+    def run(granularity):
+        cfg = kfac_tpu.KFACPreconditioner(
+            registry=reg, damping=0.01, kl_clip=0.001,
+            bucket_granularity=granularity,
+        )
+        dk = DistributedKFAC(config=cfg, mesh=mesh)
+        state, pgrads = jax.jit(dk.step)(dk.init(), grads, stats)
+        return dk, pgrads
+
+    dk_cls, pg_cls = run(128)
+    dk_exact, pg_exact = run(1)
+    # 4 distinct (da, dg) pairs collapse into 2 class buckets:
+    # (14,19)->(16,32)... wait-free check by count
+    assert len(dk_cls.buckets) < len(dk_exact.buckets)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(pg_cls), jax.tree_util.tree_leaves(pg_exact)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
         )

@@ -239,3 +239,51 @@ def test_hybrid_mesh_columns_are_contiguous_blocks():
     for c in range(ids.shape[1]):
         col = ids[:, c]
         assert list(col) == list(range(col[0], col[0] + len(col)))
+
+
+def test_describe_dumps_registration_and_assignment():
+    """Pull-based parity with the reference's construction-time logging
+    (kfac/preconditioner.py:264-268,300): the dense dump lists every layer
+    with factor dims; the distributed dump adds strategy, buckets, and
+    per-layer inverse workers."""
+    from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+
+    m = models.TinyModel()
+    x, _ = models.regression_data(jax.random.PRNGKey(1))
+    reg = kfac_tpu.register_model(m, x)
+    cfg = kfac_tpu.KFACPreconditioner(registry=reg)
+    text = cfg.describe()
+    for name, h in reg.layers.items():
+        assert name in text
+        assert f'A={h.a_factor_shape[0]}x{h.a_factor_shape[0]}' in text
+
+    dk = DistributedKFAC(config=cfg, mesh=kaisa_mesh(0.5))
+    dtext = dk.describe()
+    assert 'strategy=HYBRID_OPT' in dtext
+    assert 'bucket' in dtext
+    assert 'inverse workers' in dtext
+    for name in reg.layers:
+        assert name in dtext
+
+
+def test_metrics_writer_appends_csv(tmp_path):
+    from examples import common
+
+    path = str(tmp_path / 'metrics.csv')
+    w = common.MetricsWriter(path)
+    w.write(0, 'loss', 1.5)
+    w.write_many(1, {'loss': 1.25, 'acc': 0.5})
+    w.close()
+    # append across writer instances (resume) without duplicating the header
+    w2 = common.MetricsWriter(path)
+    w2.write(2, 'loss', 1.0)
+    w2.close()
+    lines = open(path).read().splitlines()
+    assert lines[0] == 'step,name,value'
+    assert lines[1:] == [
+        '0,loss,1.5', '1,loss,1.25', '1,acc,0.5', '2,loss,1',
+    ]
+    # disabled writer (no path) is a no-op
+    w3 = common.MetricsWriter(None)
+    w3.write(0, 'loss', 1.0)
+    w3.close()

@@ -397,3 +397,59 @@ def test_scan_steps_with_unexecuted_registered_layer():
     np.testing.assert_array_equal(
         np.asarray(state.kfac_state.a['aux_head']), np.eye(17)
     )
+
+
+def test_reset_batch_discards_poisoned_accumulation():
+    """AMP-overflow parity (reference base_preconditioner.py:384-387): a
+    poisoned micro-batch accumulated and then dropped via reset_batch must
+    leave NO trace — the finished step equals a clean step_accumulate over
+    the same good micro-batches."""
+    m = MLP(features=(16,), num_classes=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 8))
+    y = jax.nn.one_hot(jnp.arange(32) % 4, 4)
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+
+    def loss_fn(params, model_state, batch):
+        xx, yy = batch
+        logits = m.apply({'params': params}, xx)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * yy, -1)), model_state
+
+    def make_trainer():
+        kfac = kfac_tpu.KFACPreconditioner(registry=reg, damping=0.01, kl_clip=None)
+        return training.Trainer(loss_fn=loss_fn, optimizer=optax.sgd(0.1), kfac=kfac)
+
+    good = [(x[:16], y[:16]), (x[16:], y[16:])]
+    poisoned = (jnp.full_like(x[:16], jnp.inf), y[:16])
+
+    # incremental path with a simulated overflow mid-accumulation
+    t1 = make_trainer()
+    s1 = t1.init(params)
+    t1.accumulate_microbatch(s1, good[0])
+    loss_bad = t1.accumulate_microbatch(s1, poisoned)
+    assert not np.isfinite(float(loss_bad))  # the overflow the scaler sees
+    t1.reset_batch()
+    for mb in good:
+        t1.accumulate_microbatch(s1, mb)
+    s1, l1 = t1.apply_accumulated(s1)
+
+    # oracle: the same good batch with no poisoning detour
+    t2 = make_trainer()
+    s2 = t2.init(params)
+    s2, l2 = t2.step_accumulate(s2, good)
+
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(s1.params['dense0']['kernel']),
+        np.asarray(s2.params['dense0']['kernel']),
+        rtol=1e-6, atol=1e-7,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s1.kfac_state.a['dense0']),
+        np.asarray(s2.kfac_state.a['dense0']),
+        rtol=1e-6, atol=1e-7,
+    )
+    assert int(s1.kfac_state.step) == int(s2.kfac_state.step) == 1
+    # a second apply without new accumulation is an error
+    with pytest.raises(ValueError):
+        t1.apply_accumulated(s1)

@@ -246,3 +246,47 @@ def test_training_conv_net_decreases():
         params, state, loss = train_step(params, state, (x, y))
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------- platform defaults
+
+
+def test_default_compute_method_per_platform():
+    # TPU gets the matmul-only Newton-Schulz INVERSE path; everything else
+    # keeps the reference's EIGEN default (kfac/preconditioner.py:245-256).
+    assert kfac_tpu.default_compute_method('tpu') == (
+        enums.ComputeMethod.INVERSE,
+        'newton_schulz',
+    )
+    for platform in ('cpu', 'gpu', 'cuda'):
+        assert kfac_tpu.default_compute_method(platform) == (
+            enums.ComputeMethod.EIGEN,
+            'cholesky',
+        )
+
+
+def test_unset_compute_method_resolves_to_platform_default():
+    m = models.TinyModel()
+    x, _ = models.regression_data(jax.random.PRNGKey(1), n=8, dim=6)
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg)
+    # conftest pins JAX_PLATFORMS=cpu, so the resolved default is EIGEN.
+    assert kfac.compute_method == enums.ComputeMethod.EIGEN
+    assert kfac.inverse_solver == 'cholesky'
+
+
+def test_forced_eigen_on_tpu_warns(monkeypatch):
+    m = models.TinyModel()
+    x, _ = models.regression_data(jax.random.PRNGKey(1), n=8, dim=6)
+    reg = kfac_tpu.register_model(m, x)
+    monkeypatch.setattr(jax, 'default_backend', lambda: 'tpu')
+    with pytest.warns(kfac_tpu.warnings.TPUPerformanceWarning):
+        kfac_tpu.KFACPreconditioner(registry=reg, compute_method='eigen')
+    # unset on TPU: silent, resolves to the native path
+    import warnings as stdlib_warnings
+
+    with stdlib_warnings.catch_warnings():
+        stdlib_warnings.simplefilter('error')
+        kfac = kfac_tpu.KFACPreconditioner(registry=reg)
+    assert kfac.compute_method == enums.ComputeMethod.INVERSE
+    assert kfac.inverse_solver == 'newton_schulz'
