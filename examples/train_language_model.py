@@ -37,6 +37,7 @@ def _run_epochs(args, tokens_np, step_fn, start_epoch=0, on_epoch_end=None):
     closes over; ``on_epoch_end(epoch)`` handles checkpoints.
     """
     timer = common.Timer()
+    writer = common.MetricsWriter(getattr(args, 'metrics_csv', None))
     final_ppl = float('inf')
     for epoch in range(start_epoch, args.epochs):
         lm = common.Metric()
@@ -52,8 +53,14 @@ def _run_epochs(args, tokens_np, step_fn, start_epoch=0, on_epoch_end=None):
             f'epoch {epoch}: train_loss={lm.avg:.4f} ppl={final_ppl:.1f} '
             f'elapsed={timer.elapsed():.1f}s'
         )
+        writer.write_many(
+            epoch,
+            {'train_loss': lm.avg, 'ppl': final_ppl,
+             'elapsed_s': timer.elapsed()},
+        )
         if on_epoch_end is not None:
             on_epoch_end(epoch)
+    writer.close()
     return final_ppl
 
 
@@ -85,6 +92,7 @@ def main(argv=None) -> float:
     )
     common.add_train_args(p)
     common.add_kfac_args(p)
+    common.add_metrics_args(p)
     args = p.parse_args(argv)
 
     common.distributed_init()
@@ -175,12 +183,16 @@ def _pipeline_main(args) -> float:
     from kfac_tpu.parallel import PipelinedLM, PipelineKFAC
     from kfac_tpu.parallel.mesh import pipeline_mesh
 
-    if args.model_shards > 1 or args.seq_shards > 1:
+    if args.seq_shards > 1:
         raise SystemExit(
-            '--pipeline-stages composes only with data parallelism; '
-            'combining it with --model-shards/--seq-shards is not supported'
+            '--pipeline-stages does not compose with --seq-shards; '
+            'sequence parallelism requires the non-pipelined path'
         )
-    pmesh = pipeline_mesh(n_stages=args.pipeline_stages)
+    # DP x TP x PP on one mesh: --model-shards shards stage weights over
+    # the (automatic) model axis inside the pipeline schedule
+    pmesh = pipeline_mesh(
+        n_stages=args.pipeline_stages, model=args.model_shards
+    )
     tokens_np, vocab = data.lm_corpus(args.data_dir, args.vocab_size)
     plm = PipelinedLM(
         mesh=pmesh,

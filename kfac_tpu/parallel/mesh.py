@@ -78,17 +78,21 @@ def train_mesh(
 
 def pipeline_mesh(
     n_stages: int,
+    model: int = 1,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build a ('pipe', 'kfac_gw', 'kfac_col') mesh: PP composed with DP.
+    """Build a ('pipe', 'kfac_gw', 'kfac_col', 'model') mesh: PP x DP x TP.
 
-    The reference composes its pipeline with data parallelism through the
-    DeepSpeed topology and reduces factors over the DP group
-    (kfac/gpt_neox/preconditioner.py:70-73, gpt_neox/layer.py:61-93). Here
-    the composition is one mesh: stages shard over the leading ``pipe``
+    The reference composes its pipeline with data AND tensor parallelism
+    through the DeepSpeed topology and reduces factors over the DP group
+    (kfac/gpt_neox/preconditioner.py:70-73,189-191, gpt_neox/layer.py:61-93).
+    Here the composition is one mesh: stages shard over the leading ``pipe``
     axis; the batch and factor statistics shard/reduce over the KAISA data
-    axes. ``pipe`` is outermost so DP collectives (gradient and stat psum)
-    stay within a stage's device block.
+    axes; ``model`` (innermost, so Megatron-style collectives ride the
+    fastest ICI dimension) shards tensor-parallel weights within each
+    stage. The pipeline schedule runs the pipe/data axes manually
+    (shard_map) while ``model`` stays an automatic GSPMD axis, so XLA
+    inserts the TP all-reduces inside each stage application.
 
     There is no grad-worker-fraction knob: pipeline K-FAC hardwires the
     reference's MEM-OPT-among-pipe-peers placement (second-order work is
@@ -100,11 +104,14 @@ def pipeline_mesh(
     """
     devices = list(devices if devices is not None else jax.devices())
     world = len(devices)
-    if world % n_stages != 0:
-        raise ValueError(f'{world} devices not divisible by {n_stages} stages')
-    dp = world // n_stages
-    grid = np.asarray(devices, dtype=object).reshape(n_stages, 1, dp)
-    return Mesh(grid, (PIPE_AXIS, GW_AXIS, COL_AXIS))
+    if world % (n_stages * model) != 0:
+        raise ValueError(
+            f'{world} devices not divisible by {n_stages} stages '
+            f'x {model} model shards'
+        )
+    dp = world // (n_stages * model)
+    grid = np.asarray(devices, dtype=object).reshape(n_stages, 1, dp, model)
+    return Mesh(grid, (PIPE_AXIS, GW_AXIS, COL_AXIS, MODEL_AXIS))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

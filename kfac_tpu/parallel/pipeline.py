@@ -69,6 +69,14 @@ class PipelinedLM:
     Embedding and the output head run replicated outside the pipeline (they
     are a small fraction of compute); the block stack runs under the GPipe
     schedule. ``n_microbatches`` must divide the batch.
+
+    The per-stage module defaults to :class:`StageBlocks` (transformer
+    blocks) but ANY flax module mapping ``(B, S, d_model) -> (B, S,
+    d_model)`` can be pipelined via ``stage_module`` — the counterpart of
+    the reference wrapping arbitrary DeepSpeed ``PipelineModule``s
+    (kfac/gpt_neox/preconditioner.py:161-165). The K-FAC registry, capture
+    taps, TP sharding rules, and both schedules are derived from the module
+    itself, so no other knob changes.
     """
 
     mesh: Mesh
@@ -107,6 +115,21 @@ class PipelinedLM:
     # semantics as register_model's skip_layers; the reference's LM example
     # skips attention projections this way)
     skip_layers: tuple[str, ...] | None = None
+    # Tensor-parallel kinds for stage layers (layer-name regex -> 'column' /
+    # 'row' / 'replicated'), used when the mesh has a model axis of size >1.
+    # Defaults cover StageBlocks' Megatron pairing: qkv/mlp_up
+    # column-parallel, out_proj/mlp_down row-parallel — the reference's
+    # ColumnParallelLinear/RowParallelLinear assignment
+    # (kfac/gpt_neox/preconditioner.py:189-191).
+    tp_overrides: tuple[tuple[str, str], ...] = (
+        (r'.*(q_proj|k_proj|v_proj|mlp_up)', 'column'),
+        (r'.*(out_proj|mlp_down)', 'row'),
+    )
+    # Custom per-stage module: any flax module (B, S, d_model) ->
+    # (B, S, d_model). None selects StageBlocks(num_layers / n_stages
+    # transformer blocks). With a custom module, num_layers/mlp_ratio are
+    # ignored for stage construction (num_heads only feeds StageBlocks).
+    stage_module: nn.Module | None = None
 
     def __post_init__(self) -> None:
         import warnings as _warnings
@@ -124,23 +147,47 @@ class PipelinedLM:
                 f"unknown schedule {self.schedule!r}: 'gpipe' or '1f1b'"
             )
         self.n_stages = int(self.mesh.shape[PIPE_AXIS])
-        # Every non-pipe mesh axis is a data-parallel axis: the batch shards
-        # over them and factor statistics reduce over them (the reference's
-        # factor allreduce over the DP group, kfac/gpt_neox/layer.py:61-93).
+        # Every non-pipe, non-model mesh axis is a data-parallel axis: the
+        # batch shards over them and factor statistics reduce over them (the
+        # reference's factor allreduce over the DP group,
+        # kfac/gpt_neox/layer.py:61-93). The model axis (TP) is NOT a data
+        # axis: the schedule leaves it automatic — shard_map runs manual
+        # over pipe+data only — so GSPMD inserts the Megatron all-reduces
+        # inside each stage application (the reference's 3D composition,
+        # kfac/gpt_neox/preconditioner.py:70-73,189-191).
         self.data_axes = tuple(
-            ax for ax in self.mesh.axis_names if ax != PIPE_AXIS
+            ax
+            for ax in self.mesh.axis_names
+            if ax not in (PIPE_AXIS, mesh_lib.MODEL_AXIS)
         )
-        if self.num_layers % self.n_stages != 0:
-            raise ValueError('num_layers must divide evenly into stages')
-        self.blocks_per_stage = self.num_layers // self.n_stages
+        self.tp = int(dict(self.mesh.shape).get(mesh_lib.MODEL_AXIS, 1))
+        self._manual = frozenset((PIPE_AXIS,) + self.data_axes)
         self.embed = nn.Embed(self.vocab_size, self.d_model, name='embed')
-        self.stage = StageBlocks(
-            self.blocks_per_stage, self.num_heads, self.mlp_ratio, self.dtype
-        )
+        if self.stage_module is not None:
+            self.stage = self.stage_module
+        else:
+            if self.num_layers % self.n_stages != 0:
+                raise ValueError('num_layers must divide evenly into stages')
+            self.blocks_per_stage = self.num_layers // self.n_stages
+            self.stage = StageBlocks(
+                self.blocks_per_stage, self.num_heads, self.mlp_ratio,
+                self.dtype,
+            )
         self.head = nn.Dense(self.vocab_size, use_bias=False, name='lm_head')
         self.ln_f = nn.LayerNorm(dtype=jnp.float32, name='ln_f')
         # Registry of one stage's K-FAC layers (shapes identical per stage).
         x = jnp.zeros((1, 8, self.d_model), self.dtype)
+        out_shape = jax.eval_shape(
+            lambda v: self.stage.init_with_output(
+                jax.random.PRNGKey(0), v
+            )[0],
+            x,
+        ).shape
+        if out_shape != x.shape:
+            raise ValueError(
+                f'stage module must map (B, S, {self.d_model}) to itself '
+                f'(pipeline stages chain), got output shape {out_shape}'
+            )
         self.stage_registry = registry_lib.register_model(
             self.stage, x, skip_layers=list(self.skip_layers or []),
         )
@@ -170,11 +217,48 @@ class PipelinedLM:
             )['params'],
             'head': self.head.init(r_head, dummy_x.astype(jnp.float32))['params'],
         }
-        # place stage params sharded over the pipe axis
-        stage_sharding = NamedSharding(self.mesh, P(PIPE_AXIS))
-        params['stages'] = jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, stage_sharding), params['stages']
-        )
+        # place stage params sharded over the pipe axis; with TP active the
+        # feature dims additionally shard over the model axis per the
+        # registry-derived Megatron kinds
+        if self.tp > 1:
+            from kfac_tpu.parallel import tensor_parallel
+
+            tp_specs = tensor_parallel.registry_param_specs(
+                params['stages'],
+                self.stage_registry,
+                overrides=self.tp_overrides,
+                warn_unmatched=False,
+            )
+            if not any(
+                mesh_lib.MODEL_AXIS in s
+                for s in jax.tree_util.tree_leaves(
+                    tp_specs, is_leaf=lambda x: isinstance(x, P)
+                )
+            ):
+                import warnings as _warnings
+
+                _warnings.warn(
+                    f'model axis has {self.tp} shards but NO stage '
+                    'parameter matched a tensor-parallel rule — all stage '
+                    'weights are fully replicated over the model axis. '
+                    'Pass tp_overrides mapping your stage layer names to '
+                    "'column'/'row' (square layers are never sharded by "
+                    'the shape heuristic).',
+                    tensor_parallel.UnshardedParamWarning,
+                    stacklevel=2,
+                )
+            params['stages'] = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self.mesh, P(PIPE_AXIS, *s))
+                ),
+                params['stages'],
+                tp_specs,
+            )
+        else:
+            stage_sharding = NamedSharding(self.mesh, P(PIPE_AXIS))
+            params['stages'] = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, stage_sharding), params['stages']
+            )
         return params
 
     # ----------------------------------------------------------- pipeline
@@ -378,6 +462,7 @@ class PipelinedLM:
             mesh=self.mesh,
             in_specs=(P(PIPE_AXIS), bspec, gspec),
             out_specs=(bspec, {k: P(PIPE_AXIS) for k in gstats}, P(PIPE_AXIS)),
+            axis_names=self._manual,  # model stays automatic (TP via GSPMD)
         )(params['stages'], x_feed, gstats)
         x = out.reshape(b, s, self.d_model)
         x = self.ln_f.apply({'params': params['ln_f']}, x.astype(jnp.float32))
@@ -657,6 +742,7 @@ class PipelinedLM:
         out = jax.shard_map(
             self._body_1f1b,
             mesh=self.mesh,
+            axis_names=self._manual,  # model stays automatic (TP via GSPMD)
             in_specs=(P(PIPE_AXIS), P(), P(), bspec, tspec, gspec),
             out_specs=(
                 P(),                # loss (psum'd)
@@ -742,11 +828,19 @@ class PipelineKFAC:
         self.n_stages = self.model.n_stages
         # DP axes of a pipeline_mesh: each stage's eigendecompositions
         # round-robin over these peers instead of being recomputed by every
-        # data replica (eigh work / dp wall-clock), then psum-share.
+        # data replica (eigh work / dp wall-clock), then psum-share. The
+        # model axis stays automatic (factors/decomps are global over TP),
+        # mirroring PipelinedLM's manual set.
         self._dp_axes = tuple(
             ax
             for ax in self.mesh.axis_names
-            if ax != PIPE_AXIS and int(self.mesh.shape[ax]) > 1
+            if ax not in (PIPE_AXIS, mesh_lib.MODEL_AXIS)
+            and int(self.mesh.shape[ax]) > 1
+        )
+        self._manual = frozenset(
+            ax
+            for ax in self.mesh.axis_names
+            if ax != mesh_lib.MODEL_AXIS
         )
         self._dp_size = 1
         for ax in self._dp_axes:
@@ -841,6 +935,7 @@ class PipelineKFAC:
             mesh=self.mesh,
             in_specs=specs,
             out_specs=specs[:4],
+            axis_names=self._manual,
         )(
             state['a'], state['g'], state['qa'], state['qg'],
             state['da'], state['dg'],
@@ -1004,6 +1099,7 @@ class PipelineKFAC:
                 mesh=self.mesh,
                 in_specs=state_specs + (grads_spec,),
                 out_specs=state_specs[:6] + (grads_spec,),
+                axis_names=self._manual,
             )(
                 state['a'], state['g'], state['qa'], state['qg'],
                 state['da'], state['dg'], stats.a, stats.g, grads['stages'],
