@@ -45,7 +45,10 @@ def _sequential_logits(model, params, tokens):
     return model.head.apply({'params': params['head']}, x)
 
 
-@pytest.mark.parametrize('n_stages,layers', [(1, 2), (2, 4), (4, 4)])
+@pytest.mark.parametrize(
+    'n_stages,layers',
+    [(1, 2), (2, 4), pytest.param(4, 4, marks=pytest.mark.slow)],
+)
 def test_pipeline_forward_matches_sequential(n_stages, layers):
     model = _model(n_stages, num_layers=layers)
     tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
@@ -106,7 +109,9 @@ def test_pipeline_stats_match_dense_capture():
     )
 
 
-@pytest.mark.parametrize('n_stages', [2, 4])
+@pytest.mark.parametrize(
+    'n_stages', [2, pytest.param(4, marks=pytest.mark.slow)]
+)
 def test_pipeline_kfac_training(n_stages):
     model = _model(n_stages, num_layers=4, micro=4)
     tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
@@ -139,6 +144,7 @@ def test_pipeline_kfac_training(n_stages):
     assert 'pipe' in str(state['a'][key].sharding.spec)
 
 
+@pytest.mark.slow
 def test_pipeline_dp_matches_pipe_only():
     """PP composed with DP: the (2 pipe x 4 data) mesh must produce the
     same loss trajectory as the pipe-only 2-stage run on the same global
@@ -187,6 +193,7 @@ def test_pipeline_dp_matches_pipe_only():
     assert losses_dp[-1] < losses_dp[0]
 
 
+@pytest.mark.slow
 def test_pipeline_dp_stats_match_dense_capture():
     """A/G statistics captured under PP x DP equal the dense interceptor
     capture of the same single-stage model on the full batch."""
@@ -228,6 +235,7 @@ def test_pipeline_dp_stats_match_dense_capture():
         )
 
 
+@pytest.mark.slow
 def test_1f1b_matches_gpipe_loss_grads_stats():
     """The combined-scan 1F1B schedule computes the same loss, parameter
     gradients, and A/G statistics as the GPipe autodiff path — on a
@@ -266,6 +274,7 @@ def test_1f1b_matches_gpipe_loss_grads_stats():
         )
 
 
+@pytest.mark.slow
 def test_1f1b_kfac_training():
     """End-to-end: PipelineKFAC trains on the 1F1B schedule, many
     microbatches (the regime the O(stages) residual ring exists for)."""
@@ -307,6 +316,7 @@ def test_1f1b_rejects_unknown_schedule():
         )
 
 
+@pytest.mark.slow
 def test_pipeline_inverse_method_matches_eigen():
     """INVERSE (Newton-Schulz) and EIGEN solve the same damped Kronecker
     system, so pipelined training trajectories coincide."""
@@ -347,6 +357,7 @@ def test_pipeline_inverse_method_matches_eigen():
     np.testing.assert_allclose(chol, inv, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_pipeline_checkpoint_roundtrip(tmp_path):
     """PipelineKFAC state saves/restores through kfac_tpu.checkpoint:
     factors persist, decompositions rematerialize, trajectories continue
@@ -399,7 +410,9 @@ def test_pipeline_checkpoint_roundtrip(tmp_path):
     )
 
 
-@pytest.mark.parametrize('schedule', ['gpipe', '1f1b'])
+@pytest.mark.parametrize(
+    'schedule', [pytest.param('gpipe', marks=pytest.mark.slow), '1f1b']
+)
 def test_tp_pp_matches_pp_dp_only(schedule):
     """3D composition (pipe=2 x dp=2 x model=2) must reproduce the
     (pipe=2 x dp=4) loss trajectory on the same global batch: tensor

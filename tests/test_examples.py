@@ -85,6 +85,7 @@ def test_cifar_real_npz_with_augmentation(tmp_path):
     assert 0.0 <= acc <= 1.0
 
 
+@pytest.mark.slow
 def test_cifar_resume_matches_uninterrupted(tmp_path):
     """Interrupted-then-resumed training must match the uninterrupted run:
     same batches (epoch-seeded), factors restored bit-exact, decomps
@@ -168,6 +169,7 @@ def jax_flat(tree):
     return [np.asarray(l) for l in jax.tree_util.tree_leaves(tree)]
 
 
+@pytest.mark.slow
 def test_restore_checkpoint_roundtrip_bit_exact(tmp_path):
     """common.save_checkpoint -> common.restore_checkpoint restores factors
     and params bit-exact (the durable state; decomps rematerialize)."""
@@ -249,6 +251,7 @@ def test_imagenet_memmap_layout_and_normalization(tmp_path):
     assert 0.0 <= acc <= 1.0
 
 
+@pytest.mark.slow
 def test_lm_pipeline_example_smoke():
     """The LM trainer's pipeline path (DP x PP, 1F1B) runs end to end."""
     from examples import train_language_model
