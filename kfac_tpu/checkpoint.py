@@ -92,3 +92,63 @@ def restore(
     state = engine.rematerialize(state)
     extra = {k: v for k, v in payload.items() if k != 'kfac'}
     return state, extra
+
+
+def save_factors(path: str, engine: Any, state: Any) -> None:
+    """Write per-layer TRUE-DIM factors + step, independent of layout.
+
+    Unlike :func:`save` (which persists the engine's stacked arrays
+    verbatim), this stores layer-named (d, d) factors, so the checkpoint
+    restores into a DIFFERENT engine configuration — other
+    bucket_granularity, colocate_factors, mesh, or even dense vs
+    distributed. The reference's per-layer factor-dir checkpointing
+    (kfac/gpt_neox/preconditioner.py:394-447) serves the same
+    topology-migration role.
+    """
+    if not _HAS_ORBAX:
+        raise RuntimeError('orbax-checkpoint is not available')
+    step = state['step'] if isinstance(state, dict) else state.step
+    payload = {
+        'step': step,
+        'factors': engine.extract_factors(state),
+    }
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, payload)
+    ckptr.wait_until_finished()
+
+
+def load_factors(path: str, engine: Any) -> Any:
+    """Restore a :func:`save_factors` checkpoint into ``engine``'s layout.
+
+    Returns a fresh state with the loaded factors inserted and
+    decompositions rematerialized. The engine must register EXACTLY the
+    stored layer names with the stored true dims (layout — granularity,
+    colocation, mesh, dense vs distributed — is free to differ; the layer
+    set is not, and pipeline stage-stacked factors only reload into a
+    pipeline engine with the same stage count).
+    """
+    if not _HAS_ORBAX:
+        raise RuntimeError('orbax-checkpoint is not available')
+    state = engine.init()
+    step = state['step'] if isinstance(state, dict) else state.step
+    template = {
+        'step': step,
+        'factors': engine.extract_factors(state),
+    }
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        payload = ckptr.restore(path, target=template)
+    except (ValueError, KeyError) as exc:
+        raise ValueError(
+            f'factor checkpoint at {path!r} does not match this engine: '
+            'the registered layer names and their factor dims must equal '
+            'those the checkpoint was saved with (engine LAYOUT may '
+            'differ; the layer set may not, and pipeline stage counts '
+            f'must match). Original error: {exc}'
+        ) from exc
+    state = engine.insert_factors(state, payload['factors'])
+    if isinstance(state, dict):
+        state['step'] = payload['step']
+    else:
+        state = state._replace(step=payload['step'])
+    return engine.rematerialize(state)

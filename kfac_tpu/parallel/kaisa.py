@@ -740,6 +740,67 @@ class DistributedKFAC:
         (reference semantics: kfac/base_preconditioner.py:296-308)."""
         return self.update_inverses(state)
 
+    def extract_factors(
+        self, state: DistKFACState
+    ) -> dict[str, dict[str, jax.Array]]:
+        """Per-layer true-dim factors from the stacked state.
+
+        A topology-independent view: bucket keys, size classes, slot
+        padding, and colocation are all layout choices of THIS engine
+        config — the layer-named (d, d) factors are the portable content
+        (the reference's per-layer factor-dir checkpoints,
+        kfac/gpt_neox/preconditioner.py:394-447).
+        """
+        out: dict[str, dict[str, jax.Array]] = {}
+        for sb in self.a_store:
+            for i, name in enumerate(sb.layers):
+                d = sb.dims[i]
+                out.setdefault(name, {})['a'] = state.a[sb.key][i, :d, :d]
+        for sb in self.g_store:
+            for i, name in enumerate(sb.layers):
+                d = sb.dims[i]
+                out.setdefault(name, {})['g'] = state.g[sb.key][i, :d, :d]
+        return out
+
+    def insert_factors(
+        self,
+        state: DistKFACState,
+        factors: dict[str, dict[str, jax.Array]],
+    ) -> DistKFACState:
+        """Write per-layer factors into this engine's stacked layout
+        (inverse of :meth:`extract_factors`; layers absent from
+        ``factors`` keep their current rows). Call
+        :meth:`rematerialize` afterwards to rebuild decompositions."""
+
+        def rewrite(store, side):
+            out = {}
+            for sb in store:
+                stack = (
+                    state.a[sb.key] if side == 'a' else state.g[sb.key]
+                )
+                idxs = [
+                    i for i, n in enumerate(sb.layers) if n in factors
+                ]
+                if idxs:
+                    # one scatter per bucket, not one full-stack copy per
+                    # layer
+                    rows = jnp.stack([
+                        pad_factor(
+                            factors[sb.layers[i]][side].astype(
+                                self.config.factor_dtype
+                            ),
+                            sb.d,
+                        )
+                        for i in idxs
+                    ])
+                    stack = stack.at[jnp.asarray(idxs)].set(rows)
+                out[sb.key] = stack
+            return out
+
+        return state._replace(
+            a=rewrite(self.a_store, 'a'), g=rewrite(self.g_store, 'g')
+        )
+
     def describe(self) -> str:
         """Registration + placement dump: the reference's construction-time
         assignment logging (kfac/preconditioner.py:264-268,300) as a

@@ -945,6 +945,35 @@ class PipelineKFAC:
             'qa': new_qa, 'qg': new_qg, 'da': new_da, 'dg': new_dg,
         }
 
+    def extract_factors(self, state) -> dict[str, dict[str, jax.Array]]:
+        """Per-layer factors with their stage axis (portable across
+        pipeline engine configs with the SAME n_stages; cross-stage-count
+        migration would need a stage re-partition, which the reference
+        does not support either)."""
+        return {
+            name: {'a': state['a'][name], 'g': state['g'][name]}
+            for name in state['a']
+        }
+
+    def insert_factors(self, state, factors):
+        """Inverse of :meth:`extract_factors`; call
+        :meth:`rematerialize` afterwards."""
+        new = {
+            **state,
+            'a': dict(state['a']),
+            'g': dict(state['g']),
+        }
+        spec = self._spec()
+        for name, fg in factors.items():
+            if name in new['a']:
+                new['a'][name] = jax.device_put(
+                    fg['a'].astype(self.config.factor_dtype), spec
+                )
+                new['g'][name] = jax.device_put(
+                    fg['g'].astype(self.config.factor_dtype), spec
+                )
+        return new
+
     def _spec(self):
         return NamedSharding(self.mesh, P(PIPE_AXIS))
 

@@ -447,6 +447,32 @@ class KFACPreconditioner:
         """
         return self.update_inverses(state)
 
+    def extract_factors(
+        self, state: KFACState
+    ) -> dict[str, dict[str, jax.Array]]:
+        """Per-layer factors, the topology-independent checkpoint content
+        (dense state is already layer-keyed; this mirrors the distributed
+        engine's API so checkpoints move between engines/configs)."""
+        return {
+            name: {'a': state.a[name], 'g': state.g[name]}
+            for name in state.a
+        }
+
+    def insert_factors(
+        self,
+        state: KFACState,
+        factors: dict[str, dict[str, jax.Array]],
+    ) -> KFACState:
+        """Inverse of :meth:`extract_factors`; call :meth:`rematerialize`
+        afterwards."""
+        new_a = dict(state.a)
+        new_g = dict(state.g)
+        for name, fg in factors.items():
+            if name in new_a:
+                new_a[name] = fg['a'].astype(self.factor_dtype)
+                new_g[name] = fg['g'].astype(self.factor_dtype)
+        return state._replace(a=new_a, g=new_g)
+
     def describe(self) -> str:
         """Human-readable registration dump.
 
