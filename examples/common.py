@@ -52,6 +52,14 @@ def add_kfac_args(parser: argparse.ArgumentParser) -> None:
     )
     g.add_argument('--kfac-skip-layers', nargs='*', default=[])
     g.add_argument(
+        '--kfac-bucket-granularity', type=int, default=None,
+        help='size-class rounding for distributed factor buckets '
+        '(1 = exact dims; default picks per platform: 128 on TPU, 1 '
+        'elsewhere). Pin an explicit value when a stacked checkpoint '
+        'must restore on a different platform; see '
+        'KFACPreconditioner.bucket_granularity',
+    )
+    g.add_argument(
         '--kfac-verbose', action='store_true',
         help='print the registration/assignment dump at construction '
         '(the reference logs this by default, kfac/preconditioner.py:264)',
@@ -216,6 +224,7 @@ def build_kfac(args, registry, mesh=None, lr=None):
             if args.kfac_compute_method == 'auto'
             else args.kfac_compute_method
         ),
+        bucket_granularity=args.kfac_bucket_granularity,
     )
     if mesh is not None:
         from kfac_tpu.parallel import DistributedKFAC

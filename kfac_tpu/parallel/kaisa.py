@@ -216,9 +216,8 @@ class DistributedKFAC:
         self.strategy = assignment_lib.strategy_for_fraction(
             self.world, self.grad_workers / self.world
         )
-        self.granularity = int(
-            getattr(self.config, 'bucket_granularity', 128)
-        )
+        # resolved (never None) by KFACPreconditioner.__post_init__
+        self.granularity = int(self.config.bucket_granularity)
         self.buckets = build_buckets(
             self.registry, self.total_devices, self.granularity
         )

@@ -945,6 +945,20 @@ class PipelineKFAC:
             'qa': new_qa, 'qg': new_qg, 'da': new_da, 'dg': new_dg,
         }
 
+    def describe(self) -> str:
+        """Registration + placement dump (reference parity:
+        kfac/preconditioner.py:264-268,300): stage topology and the
+        stage-local MEM-OPT placement."""
+        lines = [
+            f'PipelineKFAC: {len(self.registry.layers)} layers per stage '
+            f'x {self.n_stages} stages (mesh {dict(self.mesh.shape)}), '
+            'placement=MEM-OPT among pipe peers (stage-local state), '
+            f'decomposition round-robin over dp={self._dp_size}, '
+            f'method={self.config.compute_method.name}',
+            self.config.describe(),
+        ]
+        return '\n'.join(lines)
+
     def extract_factors(self, state) -> dict[str, dict[str, jax.Array]]:
         """Per-layer factors with their stage axis (portable across
         pipeline engine configs with the SAME n_stages; cross-stage-count

@@ -150,8 +150,16 @@ class KFACPreconditioner:
     # mostly-padding ones — the execution-side counterpart of the
     # reference's greedy cost balancing (kfac/assignment.py:227-319).
     # Padding is exact (identity-block factors, zero-block grads). 1
-    # disables classing. Ignored by the dense engine.
-    bucket_granularity: int = 128
+    # disables classing. None resolves per platform: 128 on TPU (the
+    # per-distinct-shape compile dominates there) and 1 elsewhere (on
+    # CPU/GPU the padded eigh FLOPs dominate — measured ~5x slower on a
+    # ResNet at class 128 on the CPU test mesh). NOTE: stacked-layout
+    # checkpoints (checkpoint.save) encode the resolved granularity, so a
+    # platform-default checkpoint does NOT restore on a platform that
+    # resolves differently — pin an explicit value for cross-platform
+    # restores, or use checkpoint.save_factors (layout-independent).
+    # Ignored by the dense engine.
+    bucket_granularity: int | None = None
     # Whether the distributed engine stores/decomposes a layer's A and G in
     # the same stack slot (same device). False buckets A and G factors
     # independently by dimension, so the two eigendecompositions of a large
@@ -201,6 +209,13 @@ class KFACPreconditioner:
                 solver_default
                 if self.compute_method == enums.ComputeMethod.INVERSE
                 else 'cholesky'
+            )
+        if self.bucket_granularity is None:
+            self.bucket_granularity = 128 if platform == 'tpu' else 1
+        elif self.bucket_granularity < 1:
+            raise ValueError(
+                f'bucket_granularity must be >= 1 (or None for the '
+                f'platform default), got {self.bucket_granularity}'
             )
         if isinstance(self.allreduce_method, str):
             try:

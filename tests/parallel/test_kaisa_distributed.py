@@ -325,13 +325,13 @@ def test_colocate_factors_false_placement_and_numerics(method):
     )
     dk = DistributedKFAC(config=cfg, mesh=mesh)
 
-    # placement: A side groups all three layers (shared da=17, class 32)
-    # in ONE stack while G splits 16s from 4s (classes 16 and 8) — slots
-    # no longer pairwise aligned
-    assert [sb.key for sb in dk.a_store] == ['a32']
-    assert sorted(sb.key for sb in dk.g_store) == ['g16', 'g8']
-    assert dk._a_slot['r'] == ('a32', 2)
-    assert dk._g_slot['r'] == ('g8', 0)
+    # placement: A side groups all three layers (shared da=17) in ONE
+    # stack while G splits 16s from 4s — slots no longer pairwise aligned
+    # (bucket_granularity resolves to 1 = exact dims on the CPU mesh)
+    assert [sb.key for sb in dk.a_store] == ['a17']
+    assert sorted(sb.key for sb in dk.g_store) == ['g16', 'g4']
+    assert dk._a_slot['r'] == ('a17', 2)
+    assert dk._g_slot['r'] == ('g4', 0)
     assert not dk.assignment.colocate_factors
 
     cap = kfac_tpu.CurvatureCapture(reg)
@@ -343,8 +343,8 @@ def test_colocate_factors_false_placement_and_numerics(method):
     ref_state, ref_grads = ref_cfg.step(ref_cfg.init(), grads, stats)
 
     state = dk.init()
-    assert set(state.a) == {'a32'}
-    assert set(state.g) == {'g16', 'g8'}
+    assert set(state.a) == {'a17'}
+    assert set(state.g) == {'g16', 'g4'}
 
     @jax.jit
     def dstep(state, grads, stats):

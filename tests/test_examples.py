@@ -224,6 +224,7 @@ def test_restore_checkpoint_roundtrip_bit_exact(tmp_path):
     assert int(rstate.kfac_state.step) == int(state.kfac_state.step)
 
 
+@pytest.mark.slow
 def test_imagenet_memmap_layout_and_normalization(tmp_path):
     """The on-disk memmap ImageNet layout trains through the native loader
     with per-batch normalization (x stays a read-only memmap)."""
