@@ -201,7 +201,7 @@ def accuracy(logits, labels) -> float:
     return float((jnp.argmax(logits, -1) == labels).mean())
 
 
-def build_kfac(args, registry, mesh=None, lr=None):
+def build_kfac(args, registry, mesh=None, lr=None, verbose_dump=True):
     """Construct the (distributed) preconditioner from CLI flags.
 
     ``lr`` should be the live optimizer schedule so the KL-clip scale
@@ -230,10 +230,12 @@ def build_kfac(args, registry, mesh=None, lr=None):
         from kfac_tpu.parallel import DistributedKFAC
 
         dk = DistributedKFAC(config=cfg, mesh=mesh)
-        if getattr(args, 'kfac_verbose', False):
+        if verbose_dump and getattr(args, 'kfac_verbose', False):
             print(dk.describe())
         return dk
-    if getattr(args, 'kfac_verbose', False):
+    # verbose_dump=False lets callers that wrap cfg in another engine
+    # (PipelineKFAC) print that engine's dump instead of a duplicate
+    if verbose_dump and getattr(args, 'kfac_verbose', False):
         print(cfg.describe())
     return cfg
 

@@ -218,8 +218,12 @@ def _pipeline_main(args) -> float:
         args.lr, _steps_per_epoch(args, tokens_np), args.epochs,
         args.warmup_epochs, args.lr_decay,
     )
-    cfg = common.build_kfac(args, plm.stage_registry, lr=lr_sched)
+    cfg = common.build_kfac(
+        args, plm.stage_registry, lr=lr_sched, verbose_dump=False
+    )
     pk = PipelineKFAC(config=cfg, model=plm) if cfg is not None else None
+    if pk is not None and args.kfac_verbose:
+        print(pk.describe())
     optimizer = optax.chain(
         optax.clip_by_global_norm(1.0),
         optax.sgd(lr_sched, momentum=args.momentum),
