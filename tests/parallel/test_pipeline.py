@@ -528,3 +528,57 @@ def test_pipeline_rejects_shape_changing_stage():
             d_model=32, num_heads=4, num_layers=2, n_microbatches=2,
             max_len=16, stage_module=flax_nn.Dense(16),
         )
+
+
+def test_custom_stage_tp_overrides_shard_over_model_axis():
+    """A custom stage module with square layers plus explicit tp_overrides
+    shards over the model axis (the heuristic would replicate squares);
+    without overrides, the silent-replication warning fires."""
+    import warnings as stdlib_warnings
+
+    from kfac_tpu.parallel import mesh as mesh_lib
+    from kfac_tpu.parallel.tensor_parallel import UnshardedParamWarning
+
+    class SquarePair(flax_nn.Module):
+        @flax_nn.compact
+        def __call__(self, x):
+            d = x.shape[-1]
+            h = flax_nn.relu(flax_nn.Dense(d, name='first')(x))
+            return x + flax_nn.Dense(d, name='second')(h)
+
+    mesh = mesh_lib.pipeline_mesh(n_stages=2, model=2)
+
+    def build(overrides):
+        return pipeline.PipelinedLM(
+            mesh=mesh, vocab_size=64, d_model=32, num_heads=4,
+            num_layers=2, n_microbatches=2, max_len=16,
+            stage_module=SquarePair(), tp_overrides=overrides,
+        )
+
+    # no matching override: everything replicates, loudly
+    with stdlib_warnings.catch_warnings(record=True) as w:
+        stdlib_warnings.simplefilter('always')
+        build(()).init(jax.random.PRNGKey(0))
+    assert any(isinstance(x.message, UnshardedParamWarning) for x in w)
+
+    # explicit Megatron pairing: kernels shard over model, silently
+    plm = build((('.*first', 'column'), ('.*second', 'row')))
+    with stdlib_warnings.catch_warnings(record=True) as w:
+        stdlib_warnings.simplefilter('always')
+        params = plm.init(jax.random.PRNGKey(0))
+    assert not any(isinstance(x.message, UnshardedParamWarning) for x in w)
+    first = params['stages']['first']['kernel']
+    second = params['stages']['second']['kernel']
+    assert str(first.sharding.spec) == str(
+        jax.sharding.PartitionSpec('pipe', None, 'model')
+    )
+    assert str(second.sharding.spec) == str(
+        jax.sharding.PartitionSpec('pipe', 'model', None)
+    )
+    # and the sharded stage trains
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
+    loss, grads, stats = jax.jit(plm.loss_and_stats)(
+        params, (tokens, jnp.roll(tokens, -1, 1))
+    )
+    assert np.isfinite(float(loss))
+    assert set(stats.a) == {'first', 'second'}
