@@ -72,29 +72,77 @@ def compute_inverse(
     return inv.astype(inv_dtype)
 
 
-def newton_schulz_inverse(
+def gershgorin_condition_bound(
+    factor: jax.Array,
+    damping: float | jax.Array,
+) -> jax.Array:
+    """Cheap upper bound on cond(factor + damping*I) for a PSD factor.
+
+    Gershgorin's max absolute row sum bounds ``lambda_max``; damping floors
+    ``lambda_min``, so ``kappa <= ||M||_inf / damping``. One reduction —
+    usable inside jit to size Newton-Schulz iteration budgets
+    (``log2(kappa) + 5`` iterations reach the fp32 floor) or to flag factors
+    whose fp32 inverse (by ANY solver — Cholesky's backward-stable solve
+    also has forward error ``O(kappa * eps)``) cannot be trusted.
+    """
+    f = factor.astype(jnp.float32)
+    m = f + damping * jnp.eye(f.shape[-1], dtype=jnp.float32)
+    lam_max = jnp.max(jnp.sum(jnp.abs(m), axis=-1))
+    return lam_max / damping
+
+
+class NewtonSchulzInfo(NamedTuple):
+    """Result of the residual-monitored Newton-Schulz inversion.
+
+    ``inverse``: the damped inverse (inv_dtype); ``residual``: final
+    relative identity residual ``||I - M X||_F / sqrt(d)`` (fp32 scalar);
+    ``iterations``: matmul-pair iterations actually executed (int32 scalar,
+    <= the cap when the tolerance or the fp32 floor was reached early).
+    """
+
+    inverse: jax.Array
+    residual: jax.Array
+    iterations: jax.Array
+
+
+def newton_schulz_inverse_info(
     factor: jax.Array,
     damping: float | jax.Array,
     inv_dtype: jnp.dtype = jnp.float32,
-    iters: int = 30,
-) -> jax.Array:
-    """Tikhonov-damped inverse by Newton-Schulz iteration — matmuls only.
+    max_iters: int = 40,
+    tol: float = 1e-6,
+) -> NewtonSchulzInfo:
+    """Tikhonov-damped inverse by Newton-Schulz — matmuls only, with a
+    residual-based stopping rule and convergence diagnostics.
 
     ``X_{k+1} = X_k (2I - M X_k)`` with ``M = factor + damping*I`` converges
     quadratically to ``M^{-1}`` whenever ``||I - M X_0|| < 1``; the init
     ``X_0 = I / ||M||_inf`` guarantees that for symmetric PSD ``M``
     (Gershgorin: the max absolute row sum bounds lambda_max — much tighter
     than trace, whose overshoot costs log2(d) extra iterations). Per
-    eigenvalue the error is ``(1 - lam/||M||_inf)^(2^k)``, so full
-    convergence needs ~``log2(||M||_inf / lambda_min) + 5`` iterations:
-    the default 30 covers condition numbers to ~3e7. Damped curvature
-    factors have ``lambda_min >= damping``, so with damping >= 1e-3 this
-    holds for factor norms up to ~3e4; beyond that raise ``iters`` (each
-    +1 doubles the reachable condition number) or use the Cholesky solver.
-    Limiting accuracy in fp32 is ``O(kappa * eps)`` (e.g. ~2e-2 identity
-    residual at kappa=1e6) versus Cholesky's backward-stable solve — noise
-    far below the factor-EMA noise a preconditioner already carries, but
-    use ``'cholesky'`` where tight inverses matter.
+    eigenvalue the error is ``(1 - lam/||M||_inf)^(2^k)``, so convergence
+    needs ~``log2(kappa) + 5`` iterations: the default cap of 40 covers
+    condition numbers beyond 1e9 — far past the fp32 accuracy floor, so in
+    practice the *stopping rule* ends the loop, not the cap.
+
+    The loop (``lax.while_loop``) monitors the relative identity residual
+    ``r_k = ||I - M X_k||_F / sqrt(d)`` — computed from the ``M @ X``
+    product the iteration needs anyway, so monitoring costs one elementwise
+    pass + reduction per iteration, no extra matmul — and stops when ANY of:
+
+    - ``r_k <= tol`` (converged: early exit saves the remaining matmuls);
+    - ``r_k >= r_{k-1}`` (stagnation: the iteration hit its fp32 limiting
+      accuracy ``O(kappa * eps)`` — quadratic convergence means the
+      residual strictly shrinks until roundoff takes over, so the first
+      non-improving step marks the floor; continuing would only oscillate);
+    - ``k == max_iters`` (cap — a backstop, see above).
+
+    The returned ``residual`` is the honest quality statement: callers that
+    need a guarantee check it (``damped_inverse(solver='auto')`` falls back
+    to Cholesky above a threshold) instead of trusting a fixed iteration
+    count. A NaN/Inf factor yields a NaN residual, which compares False
+    against the improvement test and exits on the next iteration — the
+    diagnostics surface the poison instead of looping on it.
 
     This is the TPU-native decomposition path: ``eigh``/``cholesky`` lower
     to sequential panel algorithms that leave the MXU idle and compile
@@ -102,7 +150,8 @@ def newton_schulz_inverse(
     compile per distinct shape), while Newton-Schulz is ``2*iters`` dense
     matmuls that XLA tiles perfectly. It fills the role cuSOLVER plays for
     the reference (kfac/layers/inverse.py:186-213) with the hardware's
-    preferred primitive. The batched form is just ``jax.vmap``.
+    preferred primitive. The batched form is just ``jax.vmap`` (all lanes
+    run until the slowest lane's stopping rule fires).
     """
     f = factor.astype(jnp.float32)
     d = f.shape[-1]
@@ -110,12 +159,63 @@ def newton_schulz_inverse(
     m = f + damping * eye
     lam_max = jnp.max(jnp.sum(jnp.abs(m), axis=-1))  # Gershgorin bound
     x0 = eye / lam_max
+    sqrt_d = jnp.sqrt(jnp.asarray(d, jnp.float32))
 
-    def body(x, _):
-        return x @ (2.0 * eye - m @ x), None
+    def residual(mx):
+        return jnp.linalg.norm(eye - mx) / sqrt_d
 
-    x, _ = jax.lax.scan(body, x0, None, length=iters)
-    return x.astype(inv_dtype)
+    # Carry invariant: ``resid`` is the residual OF the carried ``x``
+    # (``mx`` is the cached ``m @ x`` it was measured from), so the
+    # diagnostics returned on exit describe the matrix the caller receives
+    # — including on a stagnation stop, where the last update made things
+    # (marginally) worse and the reported residual honestly says so. Each
+    # body still costs exactly two matmuls: the update reuses the cached
+    # ``mx`` and the new residual's product is next iteration's cache.
+    def cond(carry):
+        _, _, resid, prev, k = carry
+        return (k < max_iters) & (resid > tol) & (resid < prev)
+
+    def body(carry):
+        x, mx, resid, _, k = carry
+        x_new = x @ (2.0 * eye - mx)
+        mx_new = m @ x_new
+        return x_new, mx_new, residual(mx_new), resid, k + 1
+
+    # prev starts at inf so the first step always runs; it derives from
+    # lam_max (not a fresh constant) so that under shard_map the carry init
+    # has the same varying-manual-axes type as the residuals the body
+    # computes from ``m``.
+    mx0 = m @ x0
+    init = (x0, mx0, residual(mx0), lam_max * 0.0 + jnp.inf, 0)
+    x, _, resid, _, k = jax.lax.while_loop(cond, body, init)
+    return NewtonSchulzInfo(
+        inverse=x.astype(inv_dtype),
+        residual=resid,
+        iterations=jnp.asarray(k, jnp.int32),
+    )
+
+
+def newton_schulz_inverse(
+    factor: jax.Array,
+    damping: float | jax.Array,
+    inv_dtype: jnp.dtype = jnp.float32,
+    iters: int = 40,
+    tol: float = 1e-6,
+) -> jax.Array:
+    """Newton-Schulz damped inverse (see ``newton_schulz_inverse_info`` for
+    the iteration, stopping rule, and accuracy discussion)."""
+    return newton_schulz_inverse_info(
+        factor, damping, inv_dtype, max_iters=iters, tol=tol
+    ).inverse
+
+
+# Residual above which an fp32 inverse is considered unusable for
+# preconditioning and 'auto' re-solves via Cholesky: 5e-2 relative identity
+# residual means per-direction errors of a few percent — well past the
+# factor-EMA noise floor a preconditioner tolerates. Below it, NS at its
+# fp32 limiting accuracy is comparable to any fp32 solve (both are
+# O(kappa * eps)) and the fallback would buy nothing.
+NS_FALLBACK_RESIDUAL = 5e-2
 
 
 def damped_inverse(
@@ -123,13 +223,35 @@ def damped_inverse(
     damping: float | jax.Array,
     inv_dtype: jnp.dtype = jnp.float32,
     solver: str = 'cholesky',
-    iters: int = 30,
+    iters: int = 40,
 ) -> jax.Array:
     """Solver-dispatched damped inverse — the single place the
     ``inverse_solver`` config option is interpreted (dense, KAISA, and
-    pipeline engines all call this)."""
+    pipeline engines all call this).
+
+    Solvers: ``'cholesky'`` (direct, backward-stable), ``'newton_schulz'``
+    (matmul-only, residual-monitored — the TPU default), ``'auto'``
+    (Newton-Schulz, then ``lax.cond``-falls back to Cholesky when the final
+    residual exceeds ``NS_FALLBACK_RESIDUAL``, i.e. the factor was too
+    ill-conditioned for the fp32 iteration). Note ``'auto'`` under ``vmap``
+    (the stacked KAISA buckets) lowers the cond to a select that executes
+    BOTH branches batched — correct, but it pays the Cholesky the NS path
+    exists to avoid; on TPU stacked engines prefer ``'newton_schulz'`` and
+    monitor residuals out-of-band.
+    """
     if solver == 'newton_schulz':
         return newton_schulz_inverse(factor, damping, inv_dtype, iters=iters)
+    if solver == 'auto':
+        info = newton_schulz_inverse_info(
+            factor, damping, jnp.float32, max_iters=iters
+        )
+        bad = ~(info.residual <= NS_FALLBACK_RESIDUAL)  # NaN residual -> bad
+        out = jax.lax.cond(
+            bad,
+            lambda: compute_inverse(factor, damping, jnp.float32),
+            lambda: info.inverse,
+        )
+        return out.astype(inv_dtype)
     return compute_inverse(factor, damping, inv_dtype)
 
 

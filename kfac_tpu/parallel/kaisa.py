@@ -59,9 +59,12 @@ def size_class(d: int, granularity: int) -> int:
     (see ``pad_factor``/``pad_grad``).
 
     ``granularity <= 1`` disables classing (exact dims). Dims below the
-    granularity round to the next power of two (>= 8) so tiny layers don't
-    pay a full-class decomposition; larger dims round to the next multiple
-    of the granularity (MXU-tile friendly).
+    granularity round to the next power of two (>= 8), capped at the
+    granularity, so tiny layers don't pay a full-class decomposition (the
+    cap matters for non-power-of-two granularities, where the next power
+    of two could overshoot the class a d >= granularity dim would get);
+    larger dims round to the next multiple of the granularity (MXU-tile
+    friendly).
     """
     if granularity <= 1 or d == 0:
         return d
@@ -70,7 +73,7 @@ def size_class(d: int, granularity: int) -> int:
     c = 8
     while c < d:
         c *= 2
-    return c
+    return min(c, granularity)
 
 
 def pad_factor(m: jax.Array, c: int) -> jax.Array:
@@ -280,6 +283,22 @@ class DistributedKFAC:
             _warnings.warn(
                 'prediv_eigenvalues has no effect with the INVERSE compute '
                 'method; ignoring',
+                stacklevel=2,
+            )
+        if not self._eigen and self.config.inverse_solver == 'auto':
+            import warnings as _warnings
+
+            from kfac_tpu import warnings as kfac_warnings
+
+            _warnings.warn(
+                "inverse_solver='auto' under the stacked engine runs the "
+                'Cholesky-fallback lax.cond inside vmap, which lowers to a '
+                'select that executes BOTH branches for every bucket — the '
+                'batched Cholesky is paid unconditionally, negating the '
+                "Newton-Schulz path's advantage. Prefer "
+                "inverse_solver='newton_schulz' here and monitor residuals "
+                'via ops.factors.newton_schulz_inverse_info out-of-band.',
+                kfac_warnings.TPUPerformanceWarning,
                 stacklevel=2,
             )
 

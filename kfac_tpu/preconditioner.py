@@ -132,14 +132,22 @@ class KFACPreconditioner:
     kl_clip: ScalarOrSchedule | None = 0.001
     lr: ScalarOrSchedule = 0.1
     compute_method: enums.ComputeMethod | str | None = None
-    # INVERSE-method solver: 'cholesky' (direct, best off-TPU) or
-    # 'newton_schulz' — matmul-only damped inversion
+    # INVERSE-method solver: 'cholesky' (direct, best off-TPU),
+    # 'newton_schulz' — residual-monitored matmul-only damped inversion
     # (ops/factors.newton_schulz_inverse), the TPU-native choice: on v5e a
     # single distinct-shape eigh/cholesky costs tens of seconds of compile
-    # and ~140 ms/run at d=2048, while Newton-Schulz is 2*iters MXU matmuls.
+    # and ~140 ms/run at d=2048, while Newton-Schulz is <= 2*iters MXU
+    # matmuls with residual-based early exit — or 'auto' (Newton-Schulz
+    # with a Cholesky fallback when the final residual says the factor was
+    # too ill-conditioned for the fp32 iteration; see
+    # ops/factors.damped_inverse for the vmap cost caveat).
     # None selects per platform (see default_compute_method).
     inverse_solver: str | None = None
-    newton_schulz_iters: int = 25
+    # Iteration cap for the Newton-Schulz solver. The residual stopping
+    # rule exits earlier on benign factors (~15 iterations at kappa 1e4);
+    # 40 reaches the fp32 accuracy floor past kappa 1e9, so raising it
+    # further buys nothing — see ops/factors.newton_schulz_inverse_info.
+    newton_schulz_iters: int = 40
     prediv_eigenvalues: bool = False
     factor_dtype: Any = jnp.float32
     inv_dtype: Any = jnp.float32
@@ -186,13 +194,42 @@ class KFACPreconditioner:
                     f'unknown compute_method {self.compute_method!r}; '
                     f'expected one of {[m.name.lower() for m in enums.ComputeMethod]}'
                 ) from None
-        platform = jax.default_backend()
-        method_default, solver_default = default_compute_method(platform)
+        # Resolve the backend platform lazily: jax.default_backend()
+        # initializes the JAX backend as a side effect, which must not
+        # happen for fully-pinned configs (constructing a config would
+        # otherwise lock the platform before a caller's
+        # jax.config.update('jax_platforms', ...) — a first-touch hazard on
+        # wedged-TPU-tunnel hosts, exactly what bench.py's subprocess probe
+        # exists to avoid).
+        _platform_cache: list[str] = []
+
+        def platform() -> str:
+            if not _platform_cache:
+                _platform_cache.append(jax.default_backend())
+            return _platform_cache[0]
+
+        def platform_if_initialized() -> str | None:
+            # For advisory warnings only: probe the platform WITHOUT
+            # triggering backend initialization. An explicit-EIGEN config
+            # constructed before any jax compute simply skips the TPU perf
+            # warning rather than locking the platform to emit it.
+            try:
+                from jax._src import xla_bridge
+
+                if not xla_bridge.backends_are_initialized():
+                    return None
+            except (ImportError, AttributeError):  # pragma: no cover
+                # Private API gone (JAX upgrade): fail CLOSED — skip the
+                # advisory warning rather than risk initializing the
+                # backend just to decide whether to emit it.
+                return None
+            return platform()
+
         if self.compute_method is None:
-            self.compute_method = method_default
+            self.compute_method = default_compute_method(platform())[0]
         elif (
             self.compute_method == enums.ComputeMethod.EIGEN
-            and platform == 'tpu'
+            and platform_if_initialized() == 'tpu'
         ):
             warnings.warn(
                 'compute_method=EIGEN on a TPU backend: eigh lowers to a '
@@ -206,12 +243,12 @@ class KFACPreconditioner:
             )
         if self.inverse_solver is None:
             self.inverse_solver = (
-                solver_default
+                default_compute_method(platform())[1]
                 if self.compute_method == enums.ComputeMethod.INVERSE
                 else 'cholesky'
             )
         if self.bucket_granularity is None:
-            self.bucket_granularity = 128 if platform == 'tpu' else 1
+            self.bucket_granularity = 128 if platform() == 'tpu' else 1
         elif self.bucket_granularity < 1:
             raise ValueError(
                 f'bucket_granularity must be >= 1 (or None for the '
@@ -228,18 +265,18 @@ class KFACPreconditioner:
                     f'expected one of '
                     f'{[m.name.lower() for m in enums.AllreduceMethod]}'
                 ) from None
-        if self.inverse_solver not in ('cholesky', 'newton_schulz'):
+        if self.inverse_solver not in ('cholesky', 'newton_schulz', 'auto'):
             raise ValueError(
                 f'unknown inverse_solver {self.inverse_solver!r}; expected '
-                "'cholesky' or 'newton_schulz'"
+                "'cholesky', 'newton_schulz', or 'auto'"
             )
         if (
-            self.inverse_solver == 'newton_schulz'
+            self.inverse_solver in ('newton_schulz', 'auto')
             and self.compute_method == enums.ComputeMethod.EIGEN
         ):
             warnings.warn(
-                "inverse_solver='newton_schulz' has no effect with the "
-                'EIGEN compute method (it replaces the INVERSE-method '
+                f'inverse_solver={self.inverse_solver!r} has no effect with '
+                'the EIGEN compute method (it replaces the INVERSE-method '
                 "solve); pass compute_method='inverse' to use it",
                 stacklevel=2,
             )

@@ -118,7 +118,7 @@ def test_newton_schulz_handles_near_singular_factor():
 
 def test_newton_schulz_converges_for_ill_conditioned_factor():
     """Condition number ~1e6 (large-norm factor, small damping): the
-    Gershgorin init + 30 iterations must still converge."""
+    Gershgorin init + residual-monitored loop must still converge."""
     rng = np.random.default_rng(7)
     q, _ = np.linalg.qr(rng.normal(size=(64, 64)))
     evals = np.logspace(0, 4, 64)  # factor norm 1e4, damping 1e-2 -> 1e6
@@ -128,8 +128,86 @@ def test_newton_schulz_converges_for_ill_conditioned_factor():
     m = np.asarray(f) + 0.01 * np.eye(64)
     # NS limiting accuracy in fp32 is O(kappa * eps) ~ 0.1 here (Cholesky's
     # backward-stable solve does better; for preconditioning the difference
-    # is immaterial — see newton_schulz_inverse docstring)
+    # is immaterial — see newton_schulz_inverse_info docstring)
     resid = np.abs(np.asarray(ns) @ m - np.eye(64)).max()
     assert resid < 5e-2, resid
     # and the two inverses agree where the spectrum is well-resolved
     assert np.median(np.abs(np.asarray(ns) - np.asarray(direct))) < 1e-5
+
+
+def test_newton_schulz_early_exit_on_benign_factor():
+    """The residual stopping rule exits well before the iteration cap on a
+    well-conditioned factor, and reports a residual at/below tolerance."""
+    f = jnp.asarray(_random_spd(64, 3))
+    info = factors.newton_schulz_inverse_info(f, 0.01, max_iters=40)
+    assert int(info.iterations) < 25, int(info.iterations)
+    assert float(info.residual) <= 1e-6, float(info.residual)
+    direct = factors.compute_inverse(f, 0.01)
+    np.testing.assert_allclose(
+        np.asarray(info.inverse), np.asarray(direct), atol=5e-4
+    )
+
+
+def test_newton_schulz_stagnation_stop_at_fp32_floor():
+    """Spectrum spread ~1e9 with tiny damping: the fp32 iteration cannot
+    reach tol, so the monotonicity rule must stop it at the accuracy floor
+    (well under the cap) and report the honest, large residual."""
+    rng = np.random.default_rng(11)
+    q, _ = np.linalg.qr(rng.normal(size=(96, 96)))
+    evals = np.logspace(-5, 4, 96)  # spread 1e9
+    f = jnp.asarray((q * evals) @ q.T, jnp.float32)
+    info = factors.newton_schulz_inverse_info(f, 1e-5, max_iters=100)
+    assert float(info.residual) > 1e-6  # floor, not convergence
+    assert int(info.iterations) < 100  # stagnation fired, not the cap
+
+
+def test_newton_schulz_dead_relu_factor():
+    """Activation covariance of a layer with mostly dead units: near-zero
+    rows/cols except a small live block. Damping floors the dead subspace;
+    NS must match Cholesky on the whole inverse."""
+    rng = np.random.default_rng(13)
+    # cov of activations where only the first 8 of 48 units ever fire
+    acts = np.zeros((256, 48), np.float32)
+    acts[:, :8] = rng.normal(size=(256, 8))
+    a = acts.T @ acts / 256
+    ns = factors.newton_schulz_inverse(jnp.asarray(a), 0.01)
+    direct = factors.compute_inverse(jnp.asarray(a), 0.01)
+    np.testing.assert_allclose(
+        np.asarray(ns), np.asarray(direct), atol=5e-3, rtol=1e-3
+    )
+
+
+def test_damped_inverse_auto_falls_back_on_pathological_factor():
+    """solver='auto': when the NS residual exceeds the fallback threshold
+    (kappa ~1e9 in fp32), the result must be the Cholesky inverse."""
+    rng = np.random.default_rng(17)
+    q, _ = np.linalg.qr(rng.normal(size=(64, 64)))
+    evals = np.logspace(-5, 4, 64)
+    f = jnp.asarray((q * evals) @ q.T, jnp.float32)
+    info = factors.newton_schulz_inverse_info(f, 1e-5, max_iters=100)
+    assert float(info.residual) > factors.NS_FALLBACK_RESIDUAL  # premise
+    auto = factors.damped_inverse(f, 1e-5, solver='auto', iters=100)
+    direct = factors.compute_inverse(f, 1e-5)
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(direct))
+
+
+def test_damped_inverse_auto_keeps_ns_when_converged():
+    """solver='auto' on a benign factor returns the NS inverse (bitwise:
+    the cond must take the cheap branch), which matches Cholesky."""
+    f = jnp.asarray(_random_spd(32, 19))
+    auto = factors.damped_inverse(f, 0.01, solver='auto')
+    ns = factors.newton_schulz_inverse(f, 0.01)
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(ns))
+    direct = factors.compute_inverse(f, 0.01)
+    np.testing.assert_allclose(np.asarray(auto), np.asarray(direct), atol=5e-4)
+
+
+def test_gershgorin_condition_bound_bounds_true_condition():
+    f = _random_spd(32, 23)
+    damping = 0.01
+    m = f + damping * np.eye(32, dtype=np.float32)
+    true_cond = np.linalg.cond(m)
+    bound = float(factors.gershgorin_condition_bound(jnp.asarray(f), damping))
+    assert bound >= true_cond * 0.99, (bound, true_cond)
+    # and it is not absurdly loose: within d * kappa
+    assert bound <= true_cond * 32, (bound, true_cond)

@@ -428,6 +428,17 @@ def test_newton_schulz_solver_matches_cholesky_distributed():
         )
 
 
+def test_auto_solver_warns_under_stacked_engine():
+    """inverse_solver='auto' inside the stacked engine's vmap pays both
+    cond branches (the select lowering) — constructing the engine must say
+    so, loudly."""
+    with pytest.warns(kfac_tpu.warnings.TPUPerformanceWarning, match='auto'):
+        _setup(
+            0.5, compute_method='inverse', kl_clip=None, damping=0.01,
+            inverse_solver='auto',
+        )
+
+
 def test_size_classes_collapse_heterogeneous_shapes_exactly():
     """Heterogeneous factor dims collapse into few class buckets (the
     execution-side load balancing of the reference's greedy assignment,
@@ -444,6 +455,11 @@ def test_size_classes_collapse_heterogeneous_shapes_exactly():
     assert size_class(129, 128) == 256
     assert size_class(513, 256) == 768
     assert size_class(513, 1) == 513  # disabled
+    # non-power-of-two granularity: the sub-granularity power-of-two class
+    # is capped at the granularity (65 -> 100, not 128 > the class 100 that
+    # a dim of exactly 100 gets)
+    assert size_class(65, 100) == 100
+    assert size_class(7, 100) == 8
 
     class Hetero(nn.Module):
         @nn.compact
