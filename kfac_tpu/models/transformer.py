@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from kfac_tpu.models import moe as moe_lib
+from kfac_tpu.ops import losses
 
 
 class CausalSelfAttention(nn.Module):
@@ -150,8 +151,9 @@ def lm_loss(model: TransformerLM):
     def loss_fn(params, batch):
         tokens, targets = batch
         logits = model.apply({'params': params}, tokens)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        # fused NLL: no gather over the vocab axis, so a TP-sharded lm_head
+        # (TRANSFORMER_TP_RULES marks it vocab-parallel) keeps the matmul
+        # and softmax 1/tp per device (ops/losses.vocab_parallel_nll)
+        return jnp.mean(losses.vocab_parallel_nll(logits, targets))
 
     return loss_fn

@@ -38,6 +38,7 @@ from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
 from kfac_tpu.models import transformer as transformer_lib
 from kfac_tpu.ops import factors as factors_lib
+from kfac_tpu.ops import losses as losses_lib
 from kfac_tpu.parallel import mesh as mesh_lib
 from kfac_tpu.preconditioner import KFACPreconditioner, _resolve
 
@@ -253,6 +254,20 @@ class PipelinedLM:
                 ),
                 params['stages'],
                 tp_specs,
+            )
+            # Vocab-parallel LM head (Megatron's VocabParallelEmbedding
+            # pairing, which the reference rides through its GPT-NeoX
+            # integration): the (d, V) kernel shards V over the model axis.
+            # The model axis is automatic in both schedules' shard_maps, so
+            # GSPMD keeps the head matmul and the fused NLL's softmax
+            # reductions (ops/losses.vocab_parallel_nll) at 1/tp per device
+            # instead of replicating the full d x V matmul per microbatch.
+            params['head'] = jax.tree_util.tree_map(
+                lambda x: jax.device_put(
+                    x,
+                    NamedSharding(self.mesh, P(None, mesh_lib.MODEL_AXIS)),
+                ),
+                params['head'],
             )
         else:
             stage_sharding = NamedSharding(self.mesh, P(PIPE_AXIS))
@@ -523,12 +538,18 @@ class PipelinedLM:
         bwd_perm = [(j, (j - 1) % n) for j in range(n)]
 
         def head_loss(y, hp, lp, tgt):
-            """Summed token NLL / total_tokens for one microbatch."""
+            """Summed token NLL / total_tokens for one microbatch.
+
+            The fused NLL keeps the head vocab-parallel when the kernel is
+            sharded over the (automatic) model axis: the d x V matmul and
+            the softmax reductions stay 1/tp per device (see
+            ops/losses.vocab_parallel_nll).
+            """
             yl = self.ln_f.apply({'params': lp}, y.astype(jnp.float32))
             logits = self.head.apply({'params': hp}, yl)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-            return -jnp.sum(ll) / total_tokens
+            return jnp.sum(losses_lib.vocab_parallel_nll(logits, tgt)) / (
+                total_tokens
+            )
 
         zero_a = {
             name: jnp.zeros(h.a_factor_shape, jnp.float32)
@@ -783,9 +804,8 @@ class PipelinedLM:
         def tapped(params, gstats):
             tokens, targets = batch
             logits, a_stats, counts = self.apply(params, tokens, gstats)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-            return -jnp.mean(ll), (a_stats, counts)
+            nll = losses_lib.vocab_parallel_nll(logits, targets)
+            return jnp.mean(nll), (a_stats, counts)
 
         gstats0 = self.zero_gstats()
         (loss, (a_stats, counts)), (grads, g_stats) = jax.value_and_grad(

@@ -462,6 +462,11 @@ def test_tp_pp_matches_pp_dp_only(schedule):
     assert 'model' in str(spec), spec
     spec = params_3d['stages']['block0']['mlp_down']['kernel'].sharding.spec
     assert 'model' in str(spec), spec
+    # ... and the LM head is vocab-parallel: its (d, V) kernel shards V
+    # over the model axis, so the head matmul + fused-NLL softmax run at
+    # 1/tp per device instead of replicated per microbatch
+    hspec = params_3d['head']['kernel'].sharding.spec
+    assert hspec == jax.sharding.PartitionSpec(None, 'model'), hspec
 
 
 class _MLPStage(flax_nn.Module):
