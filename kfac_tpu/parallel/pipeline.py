@@ -257,18 +257,17 @@ class PipelinedLM:
             )
             # Vocab-parallel LM head (Megatron's VocabParallelEmbedding
             # pairing, which the reference rides through its GPT-NeoX
-            # integration): the (d, V) kernel shards V over the model axis.
-            # The model axis is automatic in both schedules' shard_maps, so
-            # GSPMD keeps the head matmul and the fused NLL's softmax
-            # reductions (ops/losses.vocab_parallel_nll) at 1/tp per device
-            # instead of replicating the full d x V matmul per microbatch.
-            params['head'] = jax.tree_util.tree_map(
-                lambda x: jax.device_put(
-                    x,
-                    NamedSharding(self.mesh, P(None, mesh_lib.MODEL_AXIS)),
-                ),
-                params['head'],
-            )
+            # integration): the (d, V) kernel shards V over the model axis
+            # per the SAME rule table the dense TransformerLM uses
+            # (TRANSFORMER_TP_RULES '.*lm_head/kernel'), so the two paths
+            # cannot drift apart. The model axis is automatic in both
+            # schedules' shard_maps, so GSPMD keeps the head matmul and the
+            # fused NLL's softmax reductions (ops/losses.vocab_parallel_nll)
+            # at 1/tp per device instead of replicating the full d x V
+            # matmul per microbatch.
+            params['head'] = tensor_parallel.shard_params(
+                {'lm_head': params['head']}, self.mesh
+            )['lm_head']
         else:
             stage_sharding = NamedSharding(self.mesh, P(PIPE_AXIS))
             params['stages'] = jax.tree_util.tree_map(
@@ -493,7 +492,11 @@ class PipelinedLM:
 
         Args (local views):
             stage_params: this stage's params (leading dim 1).
-            head_params / lnf_params: replicated head + final-norm params.
+            head_params / lnf_params: head + final-norm params — replicated
+                over the manual (pipe/data) axes; with tp > 1 the head
+                kernel is vocab-sharded over the AUTOMATIC model axis, so
+                head logic in this body must stay GSPMD-partitionable (no
+                ops assuming a full local vocab copy).
             x_feed: (M, B_m, S, D) microbatch activations.
             t_feed: (M, B_m, S) target ids.
             gstats: zero g-tap dummies, leading dim 1 (this stage's slice).

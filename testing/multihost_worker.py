@@ -1,0 +1,100 @@
+"""Worker process for the two-process multihost test.
+
+Launched by tests/parallel/test_multihost.py with KFAC_TPU_COORDINATOR /
+KFAC_TPU_NUM_PROCESSES / KFAC_TPU_PROCESS_ID set (the same rendezvous
+env-var surface scripts/run_pod.sh exports per node). Each process owns 2
+virtual CPU devices; ``multihost.initialize`` brings up the JAX distributed
+runtime, so the 4-device world spans two OS processes — the analogue of the
+reference's forked gloo process groups (testing/distributed.py:24-141),
+exercising the coordination-service + cross-process-collective paths the
+in-process 8-device mesh cannot.
+
+Prints one JSON line: {process, n_processes, n_devices, loss, checksum}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
+
+from kfac_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import kfac_tpu  # noqa: E402
+from kfac_tpu.parallel import DistributedKFAC, batch_sharding  # noqa: E402
+from testing import models  # noqa: E402
+
+
+def global_put(arr, sharding):
+    """Host array -> global jax.Array across processes (every process
+    passes the same full array; each contributes its addressable shards)."""
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
+def main() -> None:
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+
+    mesh = multihost.hybrid_kaisa_mesh(0.5)
+    m = models.TinyModel(hidden=8, out=4)
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=32, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    cfg = kfac_tpu.KFACPreconditioner(
+        registry=reg, compute_method='eigen', damping=0.01, lr=0.1,
+        bucket_granularity=1,
+    )
+    dk = DistributedKFAC(config=cfg, mesh=mesh)
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(
+        models.mse_loss(m)
+    )
+    bs = batch_sharding(mesh)
+    batch = (global_put(x, bs), global_put(y, bs))
+
+    @jax.jit
+    def step(params, state, batch):
+        (loss, _), grads, stats = run(params, batch)
+        state, pg = dk.step(state, grads, stats)
+        return state, pg, loss
+
+    state = dk.init()
+    state, pg, loss = step(params, state, batch)
+    jax.block_until_ready(loss)
+    # loss and preconditioned grads are fully replicated over the mesh, so
+    # every process can read them directly
+    checksum = float(
+        sum(
+            jnp.sum(jnp.abs(leaf.astype(jnp.float32)))
+            for leaf in jax.tree_util.tree_leaves(pg)
+        )
+    )
+    print(
+        json.dumps(
+            {
+                'process': jax.process_index(),
+                'n_processes': jax.process_count(),
+                'n_devices': len(jax.devices()),
+                'loss': float(loss),
+                'checksum': checksum,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == '__main__':
+    main()
