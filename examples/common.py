@@ -325,16 +325,23 @@ def _epoch_dir(checkpoint_dir: str, epoch: int) -> str:
     return os.path.join(os.path.abspath(checkpoint_dir), f'e{epoch:05d}')
 
 
-def save_checkpoint(checkpoint_dir, state, epoch: int = 0) -> None:
+def save_checkpoint(
+    checkpoint_dir, state, epoch: int = 0, kfac_engine=None
+) -> None:
     """Write the full training state via orbax into an epoch-versioned
     subdirectory (the reference keeps per-epoch files and resumes the
-    latest, examples/torch_cifar10_resnet.py:313-354)."""
+    latest, examples/torch_cifar10_resnet.py:313-354). Pass ``kfac_engine``
+    to record the state-layout manifest so later restores under a changed
+    config (e.g. another platform's bucket_granularity default) migrate
+    instead of failing."""
     from kfac_tpu import checkpoint
 
     path = _epoch_dir(checkpoint_dir, epoch)
     extra = _extra_payload(state, epoch)
     if state.kfac_state is not None:
-        checkpoint.save(path + '/kfac', state.kfac_state, extra=extra)
+        checkpoint.save(
+            path + '/kfac', state.kfac_state, extra=extra, engine=kfac_engine
+        )
     else:
         import orbax.checkpoint as ocp
 

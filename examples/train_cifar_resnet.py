@@ -150,7 +150,9 @@ def main(argv=None) -> float:
              'elapsed_s': timer.elapsed()},
         )
         if args.checkpoint_dir:
-            common.save_checkpoint(args.checkpoint_dir, state, epoch)
+            common.save_checkpoint(
+                args.checkpoint_dir, state, epoch, kfac_engine=trainer.kfac
+            )
     writer.close()
     return test_acc
 

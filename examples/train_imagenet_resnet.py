@@ -148,7 +148,9 @@ def main(argv=None) -> float:
              'img_per_s': imgs / max(train_secs, 1e-9)},
         )
         if args.checkpoint_dir:
-            common.save_checkpoint(args.checkpoint_dir, state, epoch)
+            common.save_checkpoint(
+                args.checkpoint_dir, state, epoch, kfac_engine=trainer.kfac
+            )
     writer.close()
     return acc_val
 

@@ -166,7 +166,9 @@ def main(argv=None) -> float:
 
     def on_epoch_end(epoch):
         if args.checkpoint_dir:
-            common.save_checkpoint(args.checkpoint_dir, state, epoch)
+            common.save_checkpoint(
+                args.checkpoint_dir, state, epoch, kfac_engine=trainer.kfac
+            )
 
     return _run_epochs(
         args, tokens_np, step_fn, start_epoch=start_epoch,

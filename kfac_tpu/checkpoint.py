@@ -13,6 +13,9 @@ per-inv-worker sharded factor directory, kfac/gpt_neox/preconditioner.py:
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
+import warnings as _warnings
 from typing import Any
 
 import jax
@@ -23,6 +26,87 @@ try:
     _HAS_ORBAX = True
 except Exception:  # pragma: no cover - orbax is in the image; belt+braces
     _HAS_ORBAX = False
+
+
+def layout_manifest(engine: Any) -> dict[str, Any]:
+    """JSON-serializable description of an engine's durable-state layout.
+
+    The stacked KAISA layout depends on config (``bucket_granularity``,
+    ``colocate_factors``) AND platform defaults, so two runs of "the same"
+    training script can produce incompatible :func:`save` payloads — the
+    reference never hits this because its ``state_dict`` is always
+    layer-keyed (kfac/base_preconditioner.py:215-265). The manifest makes
+    the layout explicit so :func:`restore` can diagnose a mismatch and
+    migrate through per-layer factors instead of surfacing an orbax shape
+    error.
+    """
+    man: dict[str, Any] = {'format': 1, 'engine': type(engine).__name__}
+    cfg = getattr(engine, 'config', engine)
+    cm = getattr(cfg, 'compute_method', None)
+    man['compute_method'] = getattr(cm, 'name', str(cm))
+    if hasattr(engine, 'a_store'):  # stacked KAISA engine
+        man['bucket_granularity'] = int(cfg.bucket_granularity)
+        man['colocate_factors'] = bool(cfg.colocate_factors)
+        man['a_store'] = [_bucket_entry(sb) for sb in engine.a_store]
+        man['g_store'] = [_bucket_entry(sb) for sb in engine.g_store]
+    if hasattr(engine, 'n_stages'):  # pipeline engine
+        man['n_stages'] = int(engine.n_stages)
+    return man
+
+
+def _bucket_entry(sb: Any) -> dict[str, Any]:
+    return {
+        'key': str(sb.key),
+        'layers': list(sb.layers),
+        'd': int(sb.d),
+        'padded': int(sb.padded),
+        'dims': [int(d) for d in sb.dims],
+    }
+
+
+# Manifest keys that determine the shape/keying of the durable payload
+# (compute_method does not: only step + a + g are durable).
+_LAYOUT_KEYS = (
+    'engine', 'bucket_granularity', 'colocate_factors', 'a_store',
+    'g_store', 'n_stages',
+)
+
+
+def _layout_view(man: dict[str, Any]) -> dict[str, Any]:
+    return {k: man[k] for k in _LAYOUT_KEYS if k in man}
+
+
+def _manifest_path(path: str) -> str:
+    return os.path.abspath(path) + '.manifest.json'
+
+
+def _factors_from_saved(
+    kfac_payload: dict[str, Any], saved_man: dict[str, Any]
+) -> dict[str, dict[str, Any]] | None:
+    """Reconstruct per-layer true-dim factors from a raw :func:`save`
+    payload using the manifest it was written with.
+
+    Returns None when the saved layout is not migratable this way
+    (pipeline states carry a stage axis whose re-partition is unsupported,
+    as in the reference).
+    """
+    if 'n_stages' in saved_man:
+        return None
+    out: dict[str, dict[str, Any]] = {}
+    if 'a_store' in saved_man:  # stacked KAISA payload: slice slots out
+        for side in ('a', 'g'):
+            for entry in saved_man[f'{side}_store']:
+                stack = kfac_payload[side][entry['key']]
+                for i, name in enumerate(entry['layers']):
+                    d = entry['dims'][i]
+                    out.setdefault(name, {})[side] = stack[i, :d, :d]
+        return out
+    # dense payload: already layer-keyed
+    for name, a in kfac_payload['a'].items():
+        out.setdefault(name, {})['a'] = a
+    for name, g in kfac_payload['g'].items():
+        out.setdefault(name, {})['g'] = g
+    return out
 
 
 def durable_state(state: Any) -> dict[str, Any]:
@@ -47,9 +131,21 @@ def _with_durable(state: Any, loaded: dict[str, Any]) -> Any:
     )
 
 
-def save(path: str, state: Any, extra: dict[str, Any] | None = None) -> None:
+def save(
+    path: str,
+    state: Any,
+    extra: dict[str, Any] | None = None,
+    engine: Any | None = None,
+) -> None:
     """Write the durable K-FAC state (plus optional extra trees, e.g. model
-    params / optax state) to ``path``."""
+    params / optax state) to ``path``.
+
+    Pass ``engine`` to also write a layout manifest sidecar
+    (``<path>.manifest.json``): :func:`restore` uses it to detect a layout
+    mismatch up front and to MIGRATE the factors into a differently-laid-out
+    engine (other ``bucket_granularity``/``colocate_factors``, dense vs
+    distributed) instead of failing on an orbax shape error.
+    """
     if not _HAS_ORBAX:
         raise RuntimeError('orbax-checkpoint is not available')
     payload = {'kfac': durable_state(state)}
@@ -58,6 +154,9 @@ def save(path: str, state: Any, extra: dict[str, Any] | None = None) -> None:
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, payload)
     ckptr.wait_until_finished()
+    if engine is not None and jax.process_index() == 0:
+        with open(_manifest_path(path), 'w') as f:
+            json.dump(layout_manifest(engine), f, indent=1)
 
 
 def restore(
@@ -70,6 +169,15 @@ def restore(
 
     ``engine`` is a :class:`kfac_tpu.KFACPreconditioner` or
     :class:`kfac_tpu.parallel.DistributedKFAC`. Returns ``(state, extra)``.
+
+    If the checkpoint carries a layout manifest (written by
+    ``save(..., engine=engine)``) and the layout differs from ``engine``'s
+    — other ``bucket_granularity``/``colocate_factors`` (including the
+    platform-resolved defaults changing across hosts), or a dense vs
+    distributed engine swap — the factors are MIGRATED automatically
+    through their per-layer true-dim form (with a warning). Only
+    stage-stacked pipeline states refuse cross-layout moves (a stage
+    re-partition is unsupported, as in the reference).
     """
     if not _HAS_ORBAX:
         raise RuntimeError('orbax-checkpoint is not available')
@@ -78,6 +186,20 @@ def restore(
     if extra_template:
         template.update(extra_template)
     ckptr = ocp.StandardCheckpointer()
+
+    saved_man = None
+    mpath = _manifest_path(path)
+    if os.path.exists(mpath):
+        with open(mpath) as f:
+            saved_man = json.load(f)
+    if saved_man is not None:
+        cur_man = layout_manifest(engine)
+        if _layout_view(saved_man) != _layout_view(cur_man):
+            return _migrate_restore(
+                path, engine, template_state, saved_man, cur_man,
+                extra_template, ckptr,
+            )
+
     try:
         payload = ckptr.restore(path, target=template)
     except (ValueError, KeyError) as exc:
@@ -86,11 +208,96 @@ def restore(
             'layout. For DistributedKFAC the stacked bucket keys/shapes '
             'depend on the config (notably bucket_granularity and '
             'colocate_factors): restore with the SAME values the '
-            f'checkpoint was saved under. Original error: {exc}'
+            'checkpoint was saved under — or write checkpoints with '
+            'save(..., engine=engine) so restore can diagnose and migrate '
+            f'layout changes. Original error: {exc}'
         ) from exc
     state = _with_durable(template_state, payload['kfac'])
     state = engine.rematerialize(state)
     extra = {k: v for k, v in payload.items() if k != 'kfac'}
+    return state, extra
+
+
+def _migrate_restore(
+    path: str,
+    engine: Any,
+    template_state: Any,
+    saved_man: dict[str, Any],
+    cur_man: dict[str, Any],
+    extra_template: dict[str, Any] | None,
+    ckptr: Any,
+) -> tuple[Any, dict[str, Any]]:
+    """Cross-layout restore: raw-load the saved payload, slice per-layer
+    factors out of it using the SAVED manifest, insert them into the
+    current engine's layout, and rematerialize."""
+    import jax.numpy as jnp
+
+    import numpy as np
+
+    diff = [
+        k
+        for k in _LAYOUT_KEYS
+        if saved_man.get(k) != cur_man.get(k)
+    ]
+    # no target shapes needed; materialize to HOST numpy — the raw restore
+    # yields arrays committed to device 0, which would conflict with the
+    # engine's mesh-sharded template inside insert_factors' scatter
+    raw = jax.tree_util.tree_map(np.asarray, ckptr.restore(path))
+    factors = _factors_from_saved(raw['kfac'], saved_man)
+    if factors is None or 'n_stages' in cur_man:
+        raise ValueError(
+            f'checkpoint at {path!r} was saved under a different, '
+            f'non-migratable state layout (differing fields: {diff}; '
+            f"saved engine {saved_man.get('engine')}, restoring into "
+            f"{cur_man.get('engine')}). Stage-stacked pipeline factors "
+            'only restore into an identical pipeline layout; use '
+            'checkpoint.save_factors / load_factors for portable factor '
+            'checkpoints.'
+        )
+    saved_layers = set(factors)
+    reg = getattr(engine, 'registry', None)
+    if reg is not None and set(reg.names()) != saved_layers:
+        raise ValueError(
+            f'checkpoint at {path!r} stores factors for layers '
+            f'{sorted(saved_layers)} but the restoring engine registers '
+            f'{sorted(reg.names())}; factor migration requires identical '
+            'layer sets.'
+        )
+    _warnings.warn(
+        f'checkpoint at {path!r} was saved under a different state layout '
+        f'(differing fields: {diff}); migrating through per-layer factors '
+        '(slower than a layout-exact restore, numerically identical)',
+        stacklevel=3,
+    )
+    state = engine.insert_factors(template_state, factors)
+    step_t = (
+        template_state['step']
+        if isinstance(template_state, dict)
+        else template_state.step
+    )
+    step = jax.device_put(
+        jnp.asarray(raw['kfac']['step'], jnp.asarray(step_t).dtype),
+        step_t.sharding,
+    )
+    if isinstance(state, dict):
+        state['step'] = step
+    else:
+        state = state._replace(step=step)
+    state = engine.rematerialize(state)
+
+    if extra_template:
+        # The target-less restore flattens custom pytree nodes (optax
+        # namedtuples and the like) into dicts/lists, so the extras must be
+        # re-read against their real templates. The raw kfac payload serves
+        # as its own target (saved structure/shapes by construction), which
+        # lets one structured restore recover the extras with the
+        # template's pytree types AND shardings.
+        payload = ckptr.restore(
+            path, target={'kfac': raw['kfac'], **extra_template}
+        )
+        extra = {k: v for k, v in payload.items() if k != 'kfac'}
+    else:
+        extra = {k: v for k, v in raw.items() if k != 'kfac'}
     return state, extra
 
 

@@ -115,8 +115,8 @@ def test_cifar_resume_matches_uninterrupted(tmp_path):
     orig_save = common.save_checkpoint
     die = {'armed': True}
 
-    def save_and_die(ckpt_dir, state, epoch=0):
-        orig_save(ckpt_dir, state, epoch)
+    def save_and_die(ckpt_dir, state, epoch=0, **kw):
+        orig_save(ckpt_dir, state, epoch, **kw)
         if die['armed'] and epoch == 0:
             raise KeyboardInterrupt
 
