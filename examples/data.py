@@ -135,13 +135,30 @@ def lm_corpus(
     n_tokens: int = 2_000_000,
     seed: int = 0,
 ):
-    """Token stream: ``corpus.npy`` (int tokens) from data_dir if present,
-    else a Zipf-distributed synthetic stream (realistic softmax skew)."""
+    """Token stream for LM training.
+
+    Preferred on-disk layout (written by ``tools/tokenize_corpus.py``, the
+    offline counterpart of the reference's torchtext PTB/WikiText pipeline,
+    examples/language/dataset.py): ``corpus.npy`` (int token ids,
+    MEMORY-MAPPED — the corpus never loads into RAM; ``lm_batches`` copies
+    only each batch's windows, the token-level equivalent of the ImageNet
+    memmap path) plus optional ``vocab.json`` (``{"size": N, ...}``) to
+    avoid a full scan for the vocab size. Falls back to a Zipf-distributed
+    synthetic stream (realistic softmax skew).
+    """
     if data_dir:
         path = os.path.join(data_dir, 'corpus.npy')
         if os.path.exists(path):
-            toks = np.load(path).astype(np.int32)
-            return toks, int(toks.max()) + 1
+            toks = np.load(path, mmap_mode='r')
+            vpath = os.path.join(data_dir, 'vocab.json')
+            if os.path.exists(vpath):
+                import json
+
+                with open(vpath) as f:
+                    vocab = int(json.load(f)['size'])
+            else:
+                vocab = int(toks.max()) + 1  # one full scan, no RAM copy
+            return toks, vocab
     rng = _rng(seed)
     toks = rng.zipf(1.3, size=n_tokens).astype(np.int64)
     toks = np.clip(toks, 1, vocab_size - 1).astype(np.int32)
@@ -190,12 +207,22 @@ def batches(x, y, batch_size: int, seed: int, drop_last: bool = True):
 
 
 def lm_batches(tokens, batch_size: int, seq_len: int, seed: int):
-    """Contiguous next-token-prediction windows."""
+    """Contiguous next-token-prediction windows.
+
+    The shuffle is a deterministic function of ``seed`` (callers pass
+    ``seed + epoch``), so a run resumed from an epoch-boundary checkpoint
+    replays exactly the batches the uninterrupted run would have seen —
+    the sampler-state property the reference gets from
+    set_epoch-per-epoch DistributedSampler seeding. ``tokens`` may be a
+    read-only memmap: only each batch's windows are copied (as int32).
+    """
     rng = _rng(seed)
     n_windows = (len(tokens) - 1) // seq_len
     starts = rng.permutation(n_windows)[: (n_windows // batch_size) * batch_size]
     for i in range(0, len(starts), batch_size):
         s = starts[i : i + batch_size] * seq_len
-        x = np.stack([tokens[a : a + seq_len] for a in s])
-        y = np.stack([tokens[a + 1 : a + seq_len + 1] for a in s])
+        x = np.stack([tokens[a : a + seq_len] for a in s]).astype(np.int32)
+        y = np.stack(
+            [tokens[a + 1 : a + seq_len + 1] for a in s]
+        ).astype(np.int32)
         yield x, y

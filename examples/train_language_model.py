@@ -279,6 +279,7 @@ def _pipeline_main(args) -> float:
                     'opt_state': opt_state,
                     'epoch': np.asarray(epoch, np.int32),
                 },
+                engine=pk,
             )
             print(f'checkpoint written to {path}')
 

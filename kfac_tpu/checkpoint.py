@@ -154,9 +154,14 @@ def save(
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, payload)
     ckptr.wait_until_finished()
-    if engine is not None and jax.process_index() == 0:
-        with open(_manifest_path(path), 'w') as f:
-            json.dump(layout_manifest(engine), f, indent=1)
+    if jax.process_index() == 0:
+        if engine is not None:
+            with open(_manifest_path(path), 'w') as f:
+                json.dump(layout_manifest(engine), f, indent=1)
+        elif os.path.exists(_manifest_path(path)):
+            # a stale sidecar from an earlier save at this path would make
+            # restore slice the NEW payload with the OLD layout
+            os.remove(_manifest_path(path))
 
 
 def restore(
@@ -263,6 +268,24 @@ def _migrate_restore(
             f'{sorted(reg.names())}; factor migration requires identical '
             'layer sets.'
         )
+    if reg is not None:
+        # Same names but different layer WIDTHS (e.g. the script's d_model
+        # changed between save and resume) must error: insert_factors would
+        # otherwise silently identity-pad the stale factors into the wider
+        # slots and train with a numerically wrong preconditioner.
+        for name, fg in factors.items():
+            h = reg.layers.get(name)
+            if h is None:
+                continue
+            exp = (tuple(h.a_factor_shape), tuple(h.g_factor_shape))
+            got = (tuple(fg['a'].shape), tuple(fg['g'].shape))
+            if exp != got:
+                raise ValueError(
+                    f'checkpoint at {path!r}: layer {name!r} stores factor '
+                    f'shapes {got} but the restoring engine expects {exp} '
+                    '— the model architecture changed between save and '
+                    'restore; factors cannot migrate across layer widths.'
+                )
     _warnings.warn(
         f'checkpoint at {path!r} was saved under a different state layout '
         f'(differing fields: {diff}); migrating through per-layer factors '

@@ -285,6 +285,60 @@ def test_checkpoint_migration_rejects_layer_set_mismatch(tmp_path):
         checkpoint.restore(path, dk)
 
 
+def test_checkpoint_migration_rejects_layer_width_change(tmp_path):
+    """Same layer names, different widths (the model's hidden size changed
+    between save and resume): migration must error, not identity-pad stale
+    factors into the wider slots."""
+    from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=64)
+
+    def setup(hidden, granularity):
+        m = models.TinyModel(hidden=hidden)
+        params = m.init(jax.random.PRNGKey(0), x)['params']
+        reg = kfac_tpu.register_model(m, x)
+        cfg = kfac_tpu.KFACPreconditioner(
+            registry=reg, kl_clip=None, bucket_granularity=granularity
+        )
+        dk = DistributedKFAC(config=cfg, mesh=kaisa_mesh(1.0))
+        run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(
+            models.mse_loss(m)
+        )
+        return m, params, dk, run
+
+    m, params, dk8, run = setup(hidden=8, granularity=1)
+    state = dk8.init()
+    (_, _), grads, stats = run(params, (x, y))
+    state, _ = jax.jit(dk8.step)(state, grads, stats)
+    path = str(tmp_path / 'width_ckpt')
+    checkpoint.save(path, state, engine=dk8)
+
+    # wider model, different granularity so the migration path triggers
+    _, _, dk16, _ = setup(hidden=16, granularity=128)
+    with pytest.raises(ValueError, match='layer widths'):
+        checkpoint.restore(path, dk16)
+
+
+def test_save_without_engine_clears_stale_manifest(tmp_path):
+    """Re-saving at a path without engine= must delete a leftover sidecar
+    so restore cannot slice the new payload with the old layout."""
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg, kl_clip=None)
+    state, params, grads, stats = _train_a_bit(kfac, reg, m, params, (x, y))
+
+    import shutil
+
+    path = str(tmp_path / 'stale_ckpt')
+    checkpoint.save(path, state, engine=kfac)
+    assert (tmp_path / 'stale_ckpt.manifest.json').exists()
+    shutil.rmtree(path)  # orbax refuses overwrite; users clear the dir
+    checkpoint.save(path, state)
+    assert not (tmp_path / 'stale_ckpt.manifest.json').exists()
+
+
 def test_factors_from_saved_refuses_pipeline_layouts():
     """Stage-stacked pipeline payloads are not migratable (stage
     re-partition unsupported, as in the reference)."""

@@ -1,0 +1,114 @@
+"""Build a tokenized memmap corpus from raw text for the LM trainer.
+
+Offline counterpart of the reference's torchtext PTB/WikiText pipeline
+(examples/language/dataset.py builds a frequency vocab over the train
+split and maps lines to id tensors): word-level tokens, most-frequent
+``--vocab-size - 2`` words kept, ``<unk>`` id 0 for the tail and
+``<eos>`` id 1 appended per line (the reference appends <eos> the same
+way). Output layout consumed by ``examples/data.lm_corpus``:
+
+    <out-dir>/corpus.npy   int32 token ids (memory-mapped by the trainer)
+    <out-dir>/vocab.json   {"size": N, "itos": [...]}
+
+Usage: python tools/tokenize_corpus.py INPUT.txt --out-dir DATA_DIR
+       python examples/train_language_model.py --data-dir DATA_DIR ...
+
+Tokenization streams the file twice (count pass + encode pass) and
+accumulates ids in bounded chunks, so corpora far larger than RAM work;
+only the final id array write is O(corpus) on disk.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+
+import numpy as np
+
+UNK, EOS = 0, 1
+
+
+def _lines(path: str, lower: bool):
+    with open(path, encoding='utf-8', errors='replace') as f:
+        for line in f:
+            yield (line.lower() if lower else line).split()
+
+
+def build_vocab(
+    text_path: str, vocab_size: int, lower: bool = True
+) -> tuple[list[str], int]:
+    """(itos, total_tokens): <unk>, <eos>, then words by descending
+    frequency. The token count (words + one <eos> per line) sizes the
+    output memmap so the encode pass never holds the corpus in RAM."""
+    counts: collections.Counter[str] = collections.Counter()
+    n_tokens = 0
+    for words in _lines(text_path, lower):
+        counts.update(words)
+        n_tokens += len(words) + 1  # + <eos>
+    keep = [w for w, _ in counts.most_common(max(0, vocab_size - 2))]
+    return ['<unk>', '<eos>'] + keep, n_tokens
+
+
+def encode_to_npy(
+    text_path: str,
+    out_path: str,
+    itos: list[str],
+    n_tokens: int,
+    lower: bool = True,
+) -> None:
+    """Stream token ids straight into ``out_path`` (.npy): peak memory is
+    one ~4 MB chunk regardless of corpus size."""
+    stoi = {w: i for i, w in enumerate(itos)}
+    out = np.lib.format.open_memmap(
+        out_path, mode='w+', dtype=np.int32, shape=(n_tokens,)
+    )
+    pos = 0
+    buf: list[int] = []
+
+    def flush():
+        nonlocal pos
+        if buf:
+            out[pos : pos + len(buf)] = np.asarray(buf, np.int32)
+            pos += len(buf)
+            buf.clear()
+
+    for words in _lines(text_path, lower):
+        buf.extend(stoi.get(w, UNK) for w in words)
+        buf.append(EOS)
+        if len(buf) >= 1 << 20:
+            flush()
+    flush()
+    assert pos == n_tokens, (pos, n_tokens)
+    out.flush()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument('text', help='raw text file (one or more sentences/line)')
+    p.add_argument('--out-dir', required=True)
+    p.add_argument('--vocab-size', type=int, default=8192)
+    p.add_argument(
+        '--no-lower', action='store_true',
+        help='keep case (default lowercases, as the reference PTB pipeline)',
+    )
+    args = p.parse_args(argv)
+
+    lower = not args.no_lower
+    itos, n_tokens = build_vocab(args.text, args.vocab_size, lower)
+    if len(itos) <= 2:  # only the specials: no actual words were seen
+        raise SystemExit(
+            f'{args.text!r} contains no tokens; refusing to write an '
+            'empty corpus (the trainer would fail with opaque errors)'
+        )
+    os.makedirs(args.out_dir, exist_ok=True)
+    out_path = os.path.join(args.out_dir, 'corpus.npy')
+    encode_to_npy(args.text, out_path, itos, n_tokens, lower)
+    with open(os.path.join(args.out_dir, 'vocab.json'), 'w') as f:
+        json.dump({'size': len(itos), 'itos': itos}, f)
+    print(f'{n_tokens} tokens, vocab {len(itos)} -> {out_path}')
+
+
+if __name__ == '__main__':
+    main()
