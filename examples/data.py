@@ -221,8 +221,10 @@ def lm_batches(tokens, batch_size: int, seq_len: int, seed: int):
     starts = rng.permutation(n_windows)[: (n_windows // batch_size) * batch_size]
     for i in range(0, len(starts), batch_size):
         s = starts[i : i + batch_size] * seq_len
-        x = np.stack([tokens[a : a + seq_len] for a in s]).astype(np.int32)
-        y = np.stack(
-            [tokens[a + 1 : a + seq_len + 1] for a in s]
-        ).astype(np.int32)
+        x = np.stack([tokens[a : a + seq_len] for a in s]).astype(
+            np.int32, copy=False
+        )
+        y = np.stack([tokens[a + 1 : a + seq_len + 1] for a in s]).astype(
+            np.int32, copy=False
+        )
         yield x, y

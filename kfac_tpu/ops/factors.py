@@ -41,15 +41,62 @@ class EigenDecomp(NamedTuple):
     d: jax.Array
 
 
+def batched_eigh(
+    factor: jax.Array, impl: str = 'xla'
+) -> tuple[jax.Array, jax.Array]:
+    """``(eigenvalues, eigenvectors)`` of a (..., d, d) symmetric stack.
+
+    ``impl='xla'``: ``jnp.linalg.eigh`` — on TPU this lowers to a
+    sequential panel algorithm that leaves the MXU idle and compiles
+    pathologically slowly at LM factor sizes (measured on v5e: tens of
+    seconds of compile per distinct shape; the batched vmap form never
+    finished compiling in 20 min — docs/ROADMAP.md), which is why the
+    repo's TPU default is INVERSE+Newton-Schulz.
+
+    ``impl='host'``: ``jax.pure_callback`` to LAPACK (``numpy.linalg.eigh``,
+    syevd) on the host CPU. Factors are small (d^2 fp32: 4 MB at d=1024),
+    so the PCIe round-trip is cheap next to a pathological device eigh —
+    the same host-offload escape hatch the reference gets for free by
+    running eigh wherever torch places it. Under vmap the callback receives
+    the batched operand directly (numpy eigh batches natively); inside
+    shard_map each device's host runs LAPACK on just its slots, preserving
+    the KAISA work division. The callback is ordered per device but
+    side-effect free, so it is safe under jit/scan.
+    """
+    f = factor.astype(jnp.float32)
+    if impl == 'host':
+        import numpy as np
+
+        def _host(m):
+            w, v = np.linalg.eigh(m)
+            return np.asarray(w, np.float32), np.asarray(v, np.float32)
+
+        return jax.pure_callback(
+            _host,
+            (
+                jax.ShapeDtypeStruct(f.shape[:-1], jnp.float32),
+                jax.ShapeDtypeStruct(f.shape, jnp.float32),
+            ),
+            f,
+            vmap_method='expand_dims',
+        )
+    if impl != 'xla':
+        raise ValueError(f"unknown eigh impl {impl!r}: 'xla' or 'host'")
+    return jnp.linalg.eigh(f)
+
+
 def compute_eigh(
     factor: jax.Array,
     inv_dtype: jnp.dtype = jnp.float32,
+    impl: str = 'xla',
 ) -> EigenDecomp:
     """Eigendecompose a (symmetrized) factor in fp32, clamp eigvals >= 0.
 
-    Reference: kfac/layers/eigen.py:295-348.
+    Reference: kfac/layers/eigen.py:295-348. ``impl`` selects the device
+    (``'xla'``) or host-offloaded (``'host'``) decomposition — see
+    :func:`batched_eigh`.
     """
-    d, q = jnp.linalg.eigh(factor.astype(jnp.float32))
+    d, q = batched_eigh(factor, impl)
     return EigenDecomp(q=q.astype(inv_dtype), d=jnp.clip(d, 0.0).astype(inv_dtype))
 
 

@@ -519,7 +519,9 @@ class DistributedKFAC:
         """
 
         def local(block):
-            d, q = jnp.linalg.eigh(block.astype(jnp.float32))
+            d, q = factors_lib.batched_eigh(
+                block, self.config.eigh_impl
+            )
             return q, jnp.clip(d, 0.0)
 
         spec = P(self.all_axes)
@@ -838,7 +840,23 @@ class DistributedKFAC:
                 f'  bucket da={b.da} dg={b.dg}: '
                 f'{len(b.layers)} layers, {b.padded} padded slots'
             )
-        lines.append('inverse workers (KAISA greedy assignment):')
+        lines.append(
+            'executed placement (slot round-robin within stacked buckets; '
+            'decomposition runs where the slot lives):'
+        )
+        for name in self.registry.names():
+            a_key, a_i = self._a_slot[name]
+            g_key, g_i = self._g_slot[name]
+            a_dev = self.slot_device('a', name)
+            g_dev = self.slot_device('g', name)
+            lines.append(
+                f'  {name}: A slot {a_key}[{a_i}] -> device {a_dev.id}, '
+                f'G slot {g_key}[{g_i}] -> device {g_dev.id}'
+            )
+        lines.append(
+            'inverse workers, cost-model view (KAISA greedy assignment — '
+            'reference-parity diagnostic, NOT the executed placement above):'
+        )
         for layer in self.assignment.get_layers():
             workers = {
                 f: self.assignment.inv_worker(layer, f)
@@ -846,6 +864,25 @@ class DistributedKFAC:
             }
             lines.append(f'  {layer}: {workers}')
         return '\n'.join(lines)
+
+    def slot_device(self, side: str, name: str) -> Any:
+        """The device that stores AND decomposes ``name``'s A or G factor.
+
+        Factor stacks shard their leading slot axis over every mesh axis
+        (``_factor_spec``), so mesh-linear device ``j`` owns slots
+        ``[j*spd, (j+1)*spd)`` with ``spd = padded / total_devices`` —
+        the executed counterpart of the reference's per-rank inv_worker
+        query (kfac/assignment.py), asserted against the real shard layout
+        in tests.
+        """
+        slot_map = self._a_slot if side == 'a' else self._g_slot
+        store = self.a_store if side == 'a' else self.g_store
+        key, i = slot_map[name]
+        padded = next(sb.padded for sb in store if sb.key == key)
+        spd = padded // self.total_devices
+        import numpy as _np
+
+        return _np.asarray(self.mesh.devices).reshape(-1)[i // spd]
 
     def memory_usage(self, state: DistKFACState) -> dict[str, int]:
         """Per-device bytes by category, read from the ACTUAL shard layout.

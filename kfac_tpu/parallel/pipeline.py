@@ -894,8 +894,8 @@ class PipelineKFAC:
         cfg = self.config
 
         def run_eigh(_):
-            adec = factors_lib.compute_eigh(a_mat, cfg.inv_dtype)
-            gdec = factors_lib.compute_eigh(g_mat, cfg.inv_dtype)
+            adec = factors_lib.compute_eigh(a_mat, cfg.inv_dtype, cfg.eigh_impl)
+            gdec = factors_lib.compute_eigh(g_mat, cfg.inv_dtype, cfg.eigh_impl)
             return adec.q, gdec.q, adec.d, gdec.d
 
         def run_inverse(_):

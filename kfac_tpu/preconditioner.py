@@ -143,6 +143,11 @@ class KFACPreconditioner:
     # ops/factors.damped_inverse for the vmap cost caveat).
     # None selects per platform (see default_compute_method).
     inverse_solver: str | None = None
+    # EIGEN-method decomposition backend: 'xla' (device eigh) or 'host'
+    # (jax.pure_callback to LAPACK on the host CPU — the escape hatch for
+    # TPU, where the device eigh's compile alone is pathological; factors
+    # are small, so the transfer is cheap). See ops/factors.batched_eigh.
+    eigh_impl: str = 'xla'
     # Iteration cap for the Newton-Schulz solver. The residual stopping
     # rule exits earlier on benign factors (~15 iterations at kappa 1e4);
     # 40 reaches the fp32 accuracy floor past kappa 1e9, so raising it
@@ -225,10 +230,16 @@ class KFACPreconditioner:
                 return None
             return platform()
 
+        if self.eigh_impl not in ('xla', 'host'):
+            raise ValueError(
+                f"unknown eigh_impl {self.eigh_impl!r}; expected 'xla' or "
+                "'host'"
+            )
         if self.compute_method is None:
             self.compute_method = default_compute_method(platform())[0]
         elif (
             self.compute_method == enums.ComputeMethod.EIGEN
+            and self.eigh_impl != 'host'  # host offload sidesteps the hazard
             and platform_if_initialized() == 'tpu'
         ):
             warnings.warn(
@@ -237,7 +248,8 @@ class KFACPreconditioner:
                 'in tens of minutes on v5e. The TPU-native path is '
                 "compute_method='inverse' with inverse_solver="
                 "'newton_schulz' (the default when compute_method is left "
-                'unset).',
+                "unset); to keep EIGEN semantics, pass eigh_impl='host' to "
+                'offload the decomposition to the host CPU (LAPACK).',
                 kfac_warnings.TPUPerformanceWarning,
                 stacklevel=2,
             )
@@ -372,8 +384,12 @@ class KFACPreconditioner:
             da, dg = dict(state.da), dict(state.dg)
             dgda = dict(state.dgda)
             for name in self.registry.layers:
-                adec = factors_lib.compute_eigh(state.a[name], self.inv_dtype)
-                gdec = factors_lib.compute_eigh(state.g[name], self.inv_dtype)
+                adec = factors_lib.compute_eigh(
+                    state.a[name], self.inv_dtype, self.eigh_impl
+                )
+                gdec = factors_lib.compute_eigh(
+                    state.g[name], self.inv_dtype, self.eigh_impl
+                )
                 qa[name], qg[name] = adec.q, gdec.q
                 if self.prediv_eigenvalues:
                     dgda[name] = factors_lib.prediv_eigenvalues(

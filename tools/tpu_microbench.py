@@ -313,6 +313,17 @@ def main():
             t = timeit(f, cov, iters=max(3, args.iters // 4))
             report(f'eigh_{d}', t)
 
+            # host-offloaded eigh (pure_callback -> LAPACK): the EIGEN
+            # method's TPU escape hatch — measures the d^2 transfer + host
+            # syevd against the device eigh above and Newton-Schulz below
+            from kfac_tpu.ops import factors as factors_lib
+
+            fh = jax.jit(
+                lambda c: factors_lib.batched_eigh(c, impl='host')
+            )
+            t = timeit(fh, cov, iters=max(3, args.iters // 4))
+            report(f'eigh_host_{d}', t)
+
             # cholesky factor + solve against identity (the INVERSE method)
             def chol_inv(c):
                 l = jax.scipy.linalg.cho_factor(
