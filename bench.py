@@ -2,9 +2,11 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Measures tokens/sec of a jitted K-FAC train step (eigen method, factor
-update every 10 steps, inverse update every 100 — the reference's ImageNet
-cadence, examples/torch_imagenet_resnet.py:158-167) against the same model
+Measures tokens/sec of a jitted K-FAC train step (the platform-default
+compute path: INVERSE + Newton-Schulz on TPU, EIGEN elsewhere — see
+kfac_tpu.default_compute_method; factor update every 10 steps, inverse
+update every 100 — the reference's ImageNet cadence,
+examples/torch_imagenet_resnet.py:158-167) against the same model
 trained with plain SGD on identical hardware in the same process.
 ``vs_baseline`` is the throughput ratio kfac/sgd: the *cost* of adding
 second-order preconditioning (1.0 = free). KAISA's value proposition is
@@ -38,6 +40,42 @@ def _log(msg: str) -> None:
     """Phase progress to stderr: a killed-by-outer-timeout run still leaves
     a diagnosable trail (round-1 lesson: rc=124 with an empty log)."""
     print(f'[bench +{time.time() - _T0:7.1f}s] {msg}', file=sys.stderr, flush=True)
+
+
+def _persist(result: dict, partial: bool = True) -> None:
+    """Snapshot the result-so-far to BENCH_PARTIAL_PATH (atomic rename).
+
+    Called after every completed phase so even a SIGKILLed run (driver
+    timeout, wedged tunnel) leaves its measured numbers on disk — the
+    round-3 lesson: a healthy measurement phase is worthless if the
+    process dies before the final JSON line prints. ``main`` re-stamps the
+    snapshot ``partial=False`` once the final line printed.
+    """
+    path = os.environ.get('BENCH_PARTIAL_PATH', 'bench_partial.json')
+    if not path:
+        return
+    tmp = f'{path}.tmp.{os.getpid()}'
+    try:
+        with open(tmp, 'w') as f:
+            json.dump({**result, 'partial': partial}, f)
+        os.replace(tmp, path)
+    except Exception:  # persistence is best-effort; never kill the bench
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _clear_partial() -> None:
+    """Remove any snapshot from a PREVIOUS run before measuring: a stale
+    file must not be misattributed to this run if it dies pre-first-phase."""
+    path = os.environ.get('BENCH_PARTIAL_PATH', 'bench_partial.json')
+    if not path:
+        return
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
 
 # bf16 peak FLOP/s per chip, keyed by device_kind substring (lowercase).
 _PEAK_FLOPS = {
@@ -160,10 +198,12 @@ def _timeit(step_for_iter, args, warmup: int = 5, iters: int = 100) -> float:
 
 
 def _run(result: dict) -> None:
+    _clear_partial()
     _log('probing backend health')
     probe = _probe_backend()
     _log(f'probe -> {probe}')
     result['probe_seconds'] = round(time.time() - _T0, 1)
+    _persist(result)
 
     import jax
 
@@ -208,6 +248,7 @@ def _run(result: dict) -> None:
     result['platform'] = dev.platform
     result['device_kind'] = getattr(dev, 'device_kind', '')
     _log(f'backend up: {dev.platform} {result["device_kind"]}')
+    _persist(result)
 
     # Overall deadline: if any single compile/execute phase stalls past the
     # budget (wedgy tunnel, pathological compile), emit whatever phases
@@ -261,6 +302,7 @@ def _run(result: dict) -> None:
         dt = (time.perf_counter() - t0) / 10
         measured = 16 * 2 * n**3 / dt
         result['clock_check_tflops'] = round(measured / 1e12, 1)
+        _persist(result)
         _log(f'clock check: {measured / 1e12:.1f} Tflop/s apparent')
     else:  # keep the CPU smoke fast
         batch, seq, d_model, layers, vocab = 4, 128, 128, 2, 512
@@ -326,12 +368,14 @@ def _run(result: dict) -> None:
     _log('timing SGD step (compile + 100 iters)')
     t_sgd = _timeit(lambda i: sgd_step, (params, 0, opt.init(params), data))
     result['sgd_tokens_per_sec'] = round(batch * seq / t_sgd, 1)
+    _persist(result)
     _log(f'sgd: {t_sgd * 1e3:.1f} ms/step; timing K-FAC eager steps')
     t_kfac = _timeit(
         lambda i: kfac_step_capture if i % 10 == 0 else kfac_step_plain,
         (params, kfac.init(), opt.init(params), data),
     )
     result['eager_tokens_per_sec'] = round(batch * seq / t_kfac, 1)
+    _persist(result)
     _log(f'kfac eager: {t_kfac * 1e3:.1f} ms/step; timing scan loop')
 
     # Fully-compiled loop: 100 steps as one lax.scan with device-side
@@ -393,6 +437,7 @@ def _run(result: dict) -> None:
         # on trust, not a measurement
         result['timing_suspect'] = True
     deadline.cancel()
+    _persist(result)
 
 
 def main() -> None:
@@ -410,6 +455,7 @@ def main() -> None:
         result['error'] = f'{type(exc).__name__}: {exc}'
         failed = True
     print(json.dumps(result))
+    _persist(result, partial=failed)
     if failed:
         sys.exit(1)
 

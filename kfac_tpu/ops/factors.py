@@ -60,8 +60,9 @@ def batched_eigh(
     running eigh wherever torch places it. Under vmap the callback receives
     the batched operand directly (numpy eigh batches natively); inside
     shard_map each device's host runs LAPACK on just its slots, preserving
-    the KAISA work division. The callback is ordered per device but
-    side-effect free, so it is safe under jit/scan.
+    the KAISA work division. ``pure_callback`` makes NO ordering guarantee
+    (XLA may reorder, batch, or elide calls) — safe here precisely because
+    the callback is pure; never add host-side state to it.
     """
     f = factor.astype(jnp.float32)
     if impl == 'host':

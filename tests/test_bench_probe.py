@@ -111,3 +111,31 @@ def test_probe_rc1_unavailable_is_retryable(scripted):
     scripted.outcomes = ['rc1', 'rc1', 'ok']
     assert bench._probe_backend() == ('tpu', 'TPU v5 lite')
     assert scripted.attempts == 3
+
+
+def test_persist_writes_partial_snapshot(tmp_path, monkeypatch):
+    """_persist leaves an atomic JSON snapshot flagged partial=True, so a
+    killed run's completed phases survive on disk."""
+    import json
+
+    path = tmp_path / 'part.json'
+    monkeypatch.setenv('BENCH_PARTIAL_PATH', str(path))
+    bench._persist({'metric': 'm', 'value': 1.5})
+    got = json.loads(path.read_text())
+    assert got == {'metric': 'm', 'value': 1.5, 'partial': True}
+    # completed runs re-stamp partial=False; a fresh run clears stale files
+    bench._persist({'metric': 'm', 'value': 1.5}, partial=False)
+    assert json.loads(path.read_text())['partial'] is False
+    bench._clear_partial()
+    assert not path.exists()
+    # overwrite is atomic (no stale tmp files left behind)
+    bench._persist({'metric': 'm', 'value': 2.5})
+    assert json.loads(path.read_text())['value'] == 2.5
+    assert list(tmp_path.glob('*.tmp.*')) == []
+
+
+def test_persist_disabled_with_empty_path(tmp_path, monkeypatch):
+    monkeypatch.setenv('BENCH_PARTIAL_PATH', '')
+    monkeypatch.chdir(tmp_path)
+    bench._persist({'metric': 'm'})
+    assert list(tmp_path.iterdir()) == []
