@@ -258,6 +258,7 @@ def _run(result: dict) -> None:
             # snapshot: the main thread may be mutating `result` right now
             out = dict(result)
             out.setdefault('error', 'internal deadline hit; partial results')
+            _persist(out)  # stdout may be a broken pipe; disk first
             print(json.dumps(out), flush=True)
         finally:
             os._exit(1)  # must fire even if the dump itself raced

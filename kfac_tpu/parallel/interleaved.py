@@ -1,0 +1,162 @@
+"""Interleaved (virtual-stage) 1F1B schedule generation.
+
+Megatron-LM's interleaved schedule (Narayanan et al. 2021, §2.2) assigns
+each pipeline rank ``v`` model chunks (logical stages ``s = c*p + r``) and
+reduces the 1F1B bubble from ``(p-1)*(tf+tb)`` to ``(p-1)*(tf+tb)/v``:
+fill/drain are paid in CHUNK units instead of whole-device-stage units.
+
+The reference rides DeepSpeed's PipelineEngine and does not implement
+interleaving; this module is the schedule half of the beyond-reference
+extension. It is PURE PYTHON — run at trace time to produce static
+per-tick lookup tables the pipeline scan can index — and is validated by
+simulation (dependency order, single-slot occupancy, bubble count) in
+tests/parallel/test_interleaved.py, independent of any XLA compile.
+
+Slot encoding: each tick, each rank executes at most one F chunk and one
+B chunk. A table entry is ``(chunk, microbatch)`` or ``(-1, -1)`` (idle).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class InterleavedSchedule(NamedTuple):
+    """Static schedule tables for one (p, v, m) configuration.
+
+    ``f`` / ``b``: int32 arrays (ticks, p, 2) — per tick and rank, the
+    (chunk, microbatch) of the forward / backward chunk-execution, or
+    (-1, -1) when that slot is idle. ``ticks``: total tick count.
+    """
+
+    f: np.ndarray
+    b: np.ndarray
+    ticks: int
+
+    @property
+    def p(self) -> int:
+        return self.f.shape[1]
+
+    def bubble_slots(self) -> int:
+        """Total idle slots (F + B) across all ranks — the bubble, in
+        chunk-execution units."""
+        idle_f = int((self.f[:, :, 0] < 0).sum())
+        idle_b = int((self.b[:, :, 0] < 0).sum())
+        return idle_f + idle_b
+
+
+def _chunk_of(k: int, p: int, v: int) -> int:
+    """Model chunk executed by the k-th F (or B) slot of a rank
+    (Megatron's get_model_chunk_id): ranks cycle chunks in blocks of p."""
+    return (k % (p * v)) // p
+
+
+def _microbatch_of(k: int, p: int, v: int) -> int:
+    """Microbatch of a rank's k-th F slot under block-of-p interleaving:
+    group g = k // (p*v) covers microbatches [g*p, (g+1)*p)."""
+    return (k // (p * v)) * p + k % p
+
+
+def generate(p: int, v: int, m: int) -> InterleavedSchedule:
+    """Event-driven interleaved 1F1B: per rank, Megatron's slot order
+    (warmup F's, steady 1F1B pairs, cooldown B's), each slot issued at the
+    earliest tick its cross-rank dependency allows.
+
+    Constraints honored (asserted in tests):
+    - F(s, mb) requires F(s-1, mb) at a strictly earlier tick (the
+      activation ppermutes between ticks); s = c*p + r, so s-1 is the
+      previous rank (same chunk) or rank p-1 of the previous chunk.
+    - B(s, mb) requires B(s+1, mb) strictly earlier, and B of the LAST
+      logical stage runs in the same tick as its F (the in-tick pivot the
+      non-interleaved scan already uses).
+    - One F slot and one B slot per rank per tick.
+
+    ``m`` must be a positive multiple of ``p`` (Megatron's interleaving
+    constraint; pad the microbatch count up, exactly like the
+    non-interleaved path pads batch to microbatches).
+    """
+    if m % p != 0 or m <= 0:
+        raise ValueError(
+            f'interleaved 1F1B needs microbatches ({m}) to be a positive '
+            f'multiple of pipeline ranks ({p})'
+        )
+    if v < 1:
+        raise ValueError(f'chunks per rank must be >= 1, got {v}')
+    total = m * v  # F slots per rank (== B slots per rank)
+    last_stage = p * v - 1
+
+    # Per-rank slot orders, Megatron style: rank r runs
+    # warmup = min((p - r - 1)*2 + (v - 1)*p, total) F's, then 1F1B pairs,
+    # then the remaining B's. B order is the F order of the REVERSED chunk
+    # sequence (chunk v-1 first).
+    warmup = [min((p - r - 1) * 2 + (v - 1) * p, total) for r in range(p)]
+
+    f_done: dict[tuple[int, int], int] = {}  # (stage, mb) -> tick
+    b_done: dict[tuple[int, int], int] = {}
+    nf = [0] * p  # next F slot index per rank
+    nb = [0] * p
+    f_rows: list[np.ndarray] = []
+    b_rows: list[np.ndarray] = []
+
+    def f_slot(r: int, k: int) -> tuple[int, int, int]:
+        c = _chunk_of(k, p, v)
+        return c * p + r, c, _microbatch_of(k, p, v)
+
+    def b_slot(r: int, k: int) -> tuple[int, int, int]:
+        c = v - 1 - _chunk_of(k, p, v)
+        return c * p + r, c, _microbatch_of(k, p, v)
+
+    tick = 0
+    while min(nb) < total:
+        f_row = np.full((p, 2), -1, np.int32)
+        b_row = np.full((p, 2), -1, np.int32)
+        fired_f: list[tuple[int, int]] = []  # (stage, mb)
+        fired_b: list[tuple[int, int]] = []
+        for r in range(p):
+            # F slot: fire when the activation dependency is met AND the
+            # in-flight count (F's without their B) stays within the
+            # warmup depth — Megatron's steady loop pairs each post-warmup
+            # F with a B, which in the per-tick (F, B) slot model is
+            # exactly this bound (the same-tick B restores it).
+            if nf[r] < total and nf[r] - nb[r] <= warmup[r]:
+                s, c, mb = f_slot(r, nf[r])
+                if s == 0 or f_done.get((s - 1, mb), tick) < tick:
+                    f_row[r] = (c, mb)
+                    fired_f.append((s, mb))
+                    nf[r] += 1
+            # B slot: needs its own F done (same tick allowed: the
+            # last-stage in-tick pivot) and the upstream cotangent
+            # B(s+1) from a strictly earlier tick (it ppermutes between
+            # ticks).
+            if nb[r] < total:
+                s, c, mb = b_slot(r, nb[r])
+                f_ok = (
+                    f_done.get((s, mb), tick + 1) <= tick
+                    or (s, mb) in fired_f
+                )
+                if s == last_stage:
+                    cot_ok = f_ok
+                else:
+                    cot_ok = b_done.get((s + 1, mb), tick) < tick
+                if f_ok and cot_ok:
+                    b_row[r] = (c, mb)
+                    fired_b.append((s, mb))
+                    nb[r] += 1
+        for s, mb in fired_f:
+            f_done[(s, mb)] = tick
+        for s, mb in fired_b:
+            b_done[(s, mb)] = tick
+        f_rows.append(f_row)
+        b_rows.append(b_row)
+        tick += 1
+        if tick > 4 * (total + 2 * p * v):  # safety: schedule must make progress
+            raise RuntimeError(
+                f'interleaved schedule deadlocked at tick {tick} '
+                f'(p={p}, v={v}, m={m}, nf={nf}, nb={nb})'
+            )
+
+    return InterleavedSchedule(
+        f=np.stack(f_rows), b=np.stack(b_rows), ticks=tick
+    )

@@ -212,3 +212,99 @@ def test_load_balance_loss_uniform_is_one():
     idx = jnp.tile(jnp.arange(4), 4).reshape(2, 8)
     lb = moe.load_balance_loss(probs, idx, 4)
     np.testing.assert_allclose(float(lb), 1.0, rtol=1e-6)
+
+
+def test_moe_factor_approximation_identity_and_precond_bound():
+    """Quantify the two documented MoE factor approximations (module
+    docstring) against a per-expert-normalized oracle instead of asserting
+    'the damping absorbs it':
+
+    1. STRUCTURE (exact): the captured A factor of expert e equals
+       ``f_e * A_oracle + (1 - f_e) * e_bias e_bias^T`` where
+       ``f_e = n_e / T`` is the routed fraction — masked-out rows are
+       all-zero except the homogeneous bias one.
+    2. CHARACTERIZATION (exact): preconditioning with the captured factor
+       at damping lam IS preconditioning with the renormalized factor
+       ``captured / f_e`` at effective damping ``lam / f_e``, up to a
+       global 1/f_e scale that kl-clip/lr absorb — the matrix identity
+       ``(M + lam)^-1 = (1/f)((M/f) + lam/f)^-1``. Verified to float
+       precision.
+    3. BOUND (measured): against the TRUE per-expert oracle the direction
+       error is real for low-traffic experts — the empty-row bias corner
+       inflates by ``(1-f_e)/f_e`` on top of the damping shift. Measured
+       on this fixture (d=8, T=64, E=4): cos(captured, oracle) at
+       lam=1e-3 is ~0.31-0.36 for f_e~0.13-0.23 but >0.91 for f_e>=0.3,
+       and increases with damping (>=0.68 at lam=0.1 everywhere). The
+       assertions pin exactly that shape: monotone improvement with
+       damping, and high-traffic experts accurate at default damping.
+    """
+    from kfac_tpu.ops import factors as factors_lib
+
+    d, t, n_experts = 8, 64, 4
+    m = moe.MoEMLP(num_experts=n_experts, mlp_ratio=1)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, t, d))
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+
+    def loss_fn(p, batch):
+        out = m.apply({'params': p}, batch[0])
+        return jnp.mean(out**2)
+
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+    (_, _), grads, stats = run(params, (x, None))
+
+    # routing decisions, read from the module's own sown intermediates
+    _, inter = m.apply({'params': params}, x, mutable=['intermediates'])
+    idx = np.asarray(
+        inter['intermediates']['expert_index'][0]
+    ).reshape(-1)
+    xf = np.asarray(x).reshape(-1, d)
+
+    cos = lambda u, v: float(
+        np.dot(u, v) / (np.linalg.norm(u) * np.linalg.norm(v))
+    )
+    checked = 0
+    for e in range(n_experts):
+        routed = xf[idx == e]
+        n_e = len(routed)
+        if n_e == 0:
+            continue
+        f_e = n_e / t
+        xb = np.concatenate([routed, np.ones((n_e, 1), np.float32)], 1)
+        a_oracle = xb.T @ xb / n_e
+        captured = np.asarray(stats.a[f'expert{e}_up'])
+
+        # 1. exact structural identity
+        bias_corner = np.zeros_like(a_oracle)
+        bias_corner[-1, -1] = 1.0
+        np.testing.assert_allclose(
+            captured, f_e * a_oracle + (1 - f_e) * bias_corner,
+            rtol=1e-4, atol=1e-5,
+        )
+
+        g = np.asarray(jax.random.normal(jax.random.PRNGKey(e), (d + 1,)))
+        by_lam = {}
+        for lam in (0.001, 0.1):
+            m_cap = np.asarray(
+                factors_lib.compute_inverse(jnp.asarray(captured), lam)
+            ) @ g
+            # 2. exact effective-damping characterization
+            m_eff = np.asarray(
+                factors_lib.compute_inverse(
+                    jnp.asarray(captured / f_e), lam / f_e
+                )
+            ) @ g
+            assert cos(m_cap, m_eff) > 1 - 1e-5, (e, lam)
+            # 3. measured bound vs the true per-expert oracle
+            m_or = np.asarray(
+                factors_lib.compute_inverse(jnp.asarray(a_oracle), lam)
+            ) @ g
+            by_lam[lam] = cos(m_cap, m_or)
+        # damping absorbs more of the approximation as it grows
+        assert by_lam[0.1] > by_lam[0.001] - 1e-6, (e, by_lam)
+        assert by_lam[0.1] > 0.6, (e, by_lam)
+        # high-traffic experts are accurate already at default damping
+        if f_e >= 0.3:
+            assert by_lam[0.001] > 0.9, (e, f_e, by_lam)
+        checked += 1
+    assert checked >= 3  # the fixture routes to most experts

@@ -230,6 +230,33 @@ def bench_vocab_head(iters: int) -> None:
         tp *= 2
 
 
+def bench_bubble() -> None:
+    """Interleaved-1F1B schedule bubble accounting (kfac_tpu.parallel.
+    interleaved): idle chunk-slots per total, normalized to stage-time
+    units so v configurations are comparable. Pure schedule math — the
+    cross-v comparison holds on any hardware. Under the combined-scan
+    (F,B)-pair tick model the interleaving gain is bounded (~25% at
+    p=4); the single-slot scan variant (one F OR B chunk per tick) is
+    the design that realizes the full (p-1)/v Megatron reduction."""
+    from kfac_tpu.parallel import interleaved
+
+    for p, m in ((4, 16), (8, 32)):
+        base = None
+        for v in (1, 2, 4):
+            sched = interleaved.generate(p, v, m)
+            idle = sched.bubble_slots() // p  # per-rank idle chunk-slots
+            stage_units = idle / v  # chunk time = stage time / v
+            if base is None:
+                base = stage_units
+            report(
+                f'pipeline_bubble_p{p}_v{v}_m{m}', 0.0,
+                ticks=sched.ticks,
+                bubble_frac=round(idle / (2 * sched.ticks), 4),
+                bubble_stage_units=round(stage_units, 2),
+                vs_v1=round(stage_units / base, 3),
+            )
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument('--sizes', type=int, nargs='*',
@@ -243,6 +270,9 @@ def main():
                    help='pipeline schedule overhead vs the dense LM')
     p.add_argument('--head', action='store_true',
                    help='vocab-parallel head: per-device cost vs tp')
+    p.add_argument('--bubble', action='store_true',
+                   help='interleaved-1F1B schedule bubble fractions '
+                   '(pure schedule math, no device work)')
     p.add_argument('--skip-factor-ops', action='store_true')
     args = p.parse_args()
 
@@ -380,6 +410,8 @@ def main():
         bench_pipeline(args.iters)
     if args.head:
         bench_vocab_head(args.iters)
+    if args.bubble:
+        bench_bubble()
 
 
 if __name__ == '__main__':
