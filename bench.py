@@ -228,14 +228,17 @@ def _run(result: dict) -> None:
     # prints and the process exits with a diagnosable error instead of
     # rc=124 from the driver's outer timeout.
     def _watchdog_fire():
-        where = (
-            'TPU backend init hung after healthy probe'
-            if probe is not None
-            else 'CPU-pinned backend init stalled'
-        )
-        result['error'] = f'{where} past the 180s watchdog'
-        print(json.dumps(result), flush=True)
-        os._exit(1)
+        try:
+            where = (
+                'TPU backend init hung after healthy probe'
+                if probe is not None
+                else 'CPU-pinned backend init stalled'
+            )
+            result['error'] = f'{where} past the 180s watchdog'
+            _persist(result)  # stdout may be a broken pipe; disk first
+            print(json.dumps(result), flush=True)
+        finally:
+            os._exit(1)  # must fire even if the dump raced/raised
 
     watchdog = threading.Timer(180.0, _watchdog_fire)
     watchdog.daemon = True

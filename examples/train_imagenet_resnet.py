@@ -27,6 +27,14 @@ from kfac_tpu.parallel import batch_sharding, kaisa_mesh
 def main(argv=None) -> float:
     p = argparse.ArgumentParser(description='ImageNet ResNet-50 + K-FAC')
     p.add_argument('--image-size', type=int, default=224)
+    p.add_argument(
+        '--arch', default='resnet50',
+        choices=['resnet50', 'resnet20', 'resnet32', 'resnet56'],
+        help='resnet50 is the reference configuration '
+        '(torch_imagenet_resnet.py); the CIFAR-style depths exist for '
+        'smoke tests and small-image runs — a full ResNet-50 K-FAC '
+        'compile takes tens of minutes on a 1-core host',
+    )
     p.add_argument('--label-smoothing', type=float, default=0.1)
     p.add_argument(
         '--native-loader', action='store_true',
@@ -51,7 +59,7 @@ def main(argv=None) -> float:
         n_train=max(args.batch_size * 8, 1024), n_test=args.batch_size * 2,
     )
     augment = real_data if args.augment is None else args.augment
-    model = resnet.resnet50(
+    model = getattr(resnet, args.arch)(
         num_classes=1000, dtype=jnp.bfloat16 if args.bf16 else jnp.float32
     )
     sample = jnp.asarray(x_train[: args.batch_size])

@@ -315,7 +315,7 @@ def test_imagenet_memmap_layout_and_normalization(tmp_path):
         [
             '--image-size', '32', '--epochs', '1', '--batch-size', '16',
             '--limit-steps', '2', '--data-dir', str(tmp_path),
-            '--native-loader',
+            '--native-loader', '--arch', 'resnet20',
             '--kfac-factor-update-steps', '1', '--kfac-inv-update-steps', '1',
         ]
     )

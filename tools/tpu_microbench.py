@@ -248,13 +248,14 @@ def bench_bubble() -> None:
             stage_units = idle / v  # chunk time = stage time / v
             if base is None:
                 base = stage_units
-            report(
-                f'pipeline_bubble_p{p}_v{v}_m{m}', 0.0,
-                ticks=sched.ticks,
-                bubble_frac=round(idle / (2 * sched.ticks), 4),
-                bubble_stage_units=round(stage_units, 2),
-                vs_v1=round(stage_units / base, 3),
-            )
+            # schedule math, not a timed measurement: no ms field
+            print(json.dumps({
+                'op': f'pipeline_bubble_p{p}_v{v}_m{m}',
+                'ticks': sched.ticks,
+                'bubble_frac': round(idle / (2 * sched.ticks), 4),
+                'bubble_stage_units': round(stage_units, 2),
+                'vs_v1': round(stage_units / base, 3),
+            }), flush=True)
 
 
 def main():
