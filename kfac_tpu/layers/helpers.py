@@ -68,6 +68,11 @@ class DenseHelper(LayerHelper):
     in_features: int
     out_features: int
     factor_dtype: Any = jnp.float32
+    # Routed (row-masked) capture: normalize factors by the NONZERO row
+    # count and put bias ones only on live rows — exact per-expert
+    # statistics for MoE expert layers (see cov.routed_linear_a_factor;
+    # opt in via register_model(..., routed_layers=[...])).
+    routed: bool = False
 
     @property
     def a_factor_shape(self) -> tuple[int, int]:
@@ -79,9 +84,15 @@ class DenseHelper(LayerHelper):
         return (self.out_features, self.out_features)
 
     def get_a_factor(self, a: jax.Array) -> jax.Array:
+        if self.routed:
+            return cov.routed_linear_a_factor(
+                a, self.has_bias, dtype=self.factor_dtype
+            )
         return cov.linear_a_factor(a, self.has_bias, dtype=self.factor_dtype)
 
     def get_g_factor(self, g: jax.Array) -> jax.Array:
+        if self.routed:
+            return cov.routed_linear_g_factor(g, dtype=self.factor_dtype)
         return cov.linear_g_factor(g, dtype=self.factor_dtype)
 
     def grads_to_matrix(self, grads: dict[str, jax.Array]) -> jax.Array:

@@ -131,6 +131,7 @@ def register_model(
     model: nn.Module,
     *args: Any,
     skip_layers: list[str] | None = None,
+    routed_layers: list[str] | None = None,
     factor_dtype: Any = jnp.float32,
     apply_fn: Callable[..., Any] | None = None,
     **kwargs: Any,
@@ -141,8 +142,17 @@ def register_model(
     interceptor that records each supported module call. ``skip_layers`` are
     regex patterns matched against both the layer path name and the module
     class name (reference semantics: kfac/layers/register.py:57-95).
+
+    ``routed_layers`` (regexes over the layer path, dense layers only)
+    mark row-masked layers — MoE expert projections whose input buffers
+    zero the non-routed rows — for routed capture: factors normalize by
+    the live row count and bias ones attach only to live rows, making the
+    captured statistics EXACTLY the per-expert oracle instead of the
+    routed-fraction-scaled approximation (e.g.
+    ``routed_layers=[r'.*expert\\d+_(up|down)']`` for ``models/moe.py``).
     """
     skip_patterns = [re.compile(p) for p in (skip_layers or [])]
+    routed_patterns = [re.compile(p) for p in (routed_layers or [])]
     found: dict[str, helpers.LayerHelper] = {}
     param_paths: dict[str, tuple[str, ...]] = {}
 
@@ -159,6 +169,14 @@ def register_model(
             return next_fun(*iargs, **ikwargs)
         helper = make_helper(mod, name, tuple(x.shape), factor_dtype)
         if helper is not None and name not in found:
+            if any_match(name, routed_patterns):
+                if not isinstance(helper, helpers.DenseHelper):
+                    raise ValueError(
+                        f'routed_layers matched {name!r}, which is not a '
+                        'dense layer (routed capture is defined for '
+                        'row-masked dense inputs only)'
+                    )
+                helper = dataclasses.replace(helper, routed=True)
             found[name] = helper
             param_paths[name] = tuple(mod.path)
         return next_fun(*iargs, **ikwargs)
@@ -184,6 +202,19 @@ def register_model(
             return model.init(jax.random.PRNGKey(0), *full_args, **full_kwargs)
 
     jax.eval_shape(probe, [leaves[i] for i in traced_positions])
+    if routed_patterns:
+        unmatched = [
+            p.pattern
+            for p in routed_patterns
+            if not any(p.fullmatch(name) for name in found)
+        ]
+        if unmatched:
+            raise ValueError(
+                f'routed_layers patterns {unmatched} matched no registered '
+                'layer — a typo here silently reverts the expert layers to '
+                'the approximate shared-normalization capture, so it is an '
+                f'error. Registered layers: {sorted(found)}'
+            )
     return Registry(layers=dict(found), param_paths=dict(param_paths))
 
 

@@ -37,10 +37,13 @@ the stacked-bucket KAISA design:
   ``(1 - f_e) / f_e``. Consequence (measured): accurate for high-traffic
   experts (direction cosine vs the oracle > 0.9 at f_e >= 0.3, default
   damping) but REAL error for low-traffic ones (cosine ~0.3 at
-  f_e ~ 0.13, damping 1e-3), shrinking as damping grows. With a
-  load-balance loss keeping f_e near 1/E, choose damping accordingly;
-  exact per-expert normalization would need per-layer capture scales
-  (engine plumbing recorded in docs/ROADMAP.md).
+  f_e ~ 0.13, damping 1e-3), shrinking as damping grows. To remove the
+  approximation entirely, register with
+  ``routed_layers=[r'.*expert\\d+_(up|down)']``: routed capture
+  normalizes each expert's factors by its LIVE row count with bias ones
+  on live rows only, making the captured statistics exactly the
+  per-expert oracle (verified to float precision in
+  tests/test_moe.py::test_routed_capture_matches_per_expert_oracle_exactly).
 - Expert parallelism is a layout choice: stack the expert axis over the
   ``model`` mesh axis by passing TP overrides (column for ``*_up``, row for
   ``*_down``) to :func:`kfac_tpu.parallel.tensor_parallel

@@ -150,6 +150,66 @@ def linear_g_factor(
     return get_cov(g)
 
 
+def routed_linear_a_factor(
+    a: jax.Array,
+    has_bias: bool,
+    dtype: jnp.dtype | None = None,
+) -> jax.Array:
+    """A factor over only the NONZERO rows — exact per-expert statistics
+    for row-masked (MoE-routed) dense layers.
+
+    A routed expert sees a buffer where non-routed rows are identically
+    zero; the plain :func:`linear_a_factor` then (a) normalizes by the
+    TOTAL row count, scaling the factor by the routed fraction, and
+    (b) appends bias ones to EVERY row, inflating the bias corner by the
+    empty rows — the two documented approximations quantified in
+    tests/test_moe.py. This variant detects the zero rows, appends the
+    bias one only to live rows, and normalizes by the live count: the
+    result equals the covariance computed from just the routed tokens
+    (the per-expert oracle). An all-zero input returns zeros (count
+    floors at one). The covariance still rides :func:`get_cov` (Pallas
+    on TPU); the correction is one mask reduction plus a scalar rescale.
+
+    Exactness scope: PER CAPTURE. Across captures the engines follow the
+    standard K-FAC convention of averaging per-batch-normalized factors
+    (EMA over steps; mean over grad-accumulation micro-steps), so the
+    combined factor is an average of per-capture oracles — for routed
+    layers that weights each capture equally rather than by its live
+    count, and a capture where the expert received ZERO tokens
+    contributes an all-zero matrix. With batches large enough that every
+    expert sees traffic each capture (the regime a load-balance loss
+    maintains), this matches the oracle's own ratio-then-average
+    convention; pathologically starved experts dilute toward zero, which
+    damping floors.
+    """
+    if dtype is not None:
+        a = a.astype(dtype)
+    a = a.reshape(-1, a.shape[-1])
+    nz = (jnp.max(jnp.abs(a), axis=-1) > 0).astype(a.dtype)
+    n = jnp.maximum(jnp.sum(nz), 1.0)
+    if has_bias:
+        a = jnp.concatenate([a, nz[:, None]], axis=-1)
+    return get_cov(a) * (a.shape[0] / n)
+
+
+def routed_linear_g_factor(
+    g: jax.Array,
+    dtype: jnp.dtype | None = None,
+) -> jax.Array:
+    """G factor normalized by the nonzero-cotangent row count (the routed
+    tokens: non-routed rows have exactly-zero output cotangents). Caveat:
+    a ROUTED row whose cotangent happens to vanish is miscounted as
+    unrouted — generically measure-zero, and the resulting overnormalize
+    is bounded by 1/n_e per such row.
+    """
+    if dtype is not None:
+        g = g.astype(dtype)
+    g = g.reshape(-1, g.shape[-1])
+    nz = (jnp.max(jnp.abs(g), axis=-1) > 0).astype(g.dtype)
+    n = jnp.maximum(jnp.sum(nz), 1.0)
+    return get_cov(g) * (g.shape[0] / n)
+
+
 def conv2d_a_factor(
     a: jax.Array,
     kernel_size: tuple[int, int],

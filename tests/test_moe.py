@@ -308,3 +308,108 @@ def test_moe_factor_approximation_identity_and_precond_bound():
             assert by_lam[0.001] > 0.9, (e, f_e, by_lam)
         checked += 1
     assert checked >= 3  # the fixture routes to most experts
+
+
+def test_routed_capture_matches_per_expert_oracle_exactly():
+    """register_model(routed_layers=...) removes both documented MoE
+    approximations: the captured A and G factors equal the
+    per-expert-normalized oracle (live-row count, bias ones on live rows
+    only), so preconditioning matches the oracle to float precision even
+    for low-traffic experts at default damping."""
+    from kfac_tpu.ops import factors as factors_lib
+
+    d, t, n_experts = 8, 64, 4
+    m = moe.MoEMLP(num_experts=n_experts, mlp_ratio=1)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, t, d))
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    reg = kfac_tpu.register_model(
+        m, x, routed_layers=[r'.*expert\d+_(up|down)']
+    )
+    assert reg.layers['expert0_up'].routed
+    assert not reg.layers['router'].routed
+
+    def loss_fn(p, batch):
+        out = m.apply({'params': p}, batch[0])
+        return jnp.mean(out**2)
+
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+    (_, _), grads, stats = run(params, (x, None))
+
+    _, inter = m.apply({'params': params}, x, mutable=['intermediates'])
+    idx = np.asarray(
+        inter['intermediates']['expert_index'][0]
+    ).reshape(-1)
+    xf = np.asarray(x).reshape(-1, d)
+
+    cos = lambda u, v: float(
+        np.dot(u, v) / (np.linalg.norm(u) * np.linalg.norm(v))
+    )
+    checked = 0
+    for e in range(n_experts):
+        routed = xf[idx == e]
+        n_e = len(routed)
+        if n_e == 0:
+            continue
+        xb = np.concatenate([routed, np.ones((n_e, 1), np.float32)], 1)
+        a_oracle = xb.T @ xb / n_e
+        captured = np.asarray(stats.a[f'expert{e}_up'])
+        np.testing.assert_allclose(captured, a_oracle, rtol=1e-4, atol=1e-5)
+
+        # preconditioning now matches the oracle everywhere, including
+        # the low-traffic experts that the shared normalization distorted
+        g = np.asarray(jax.random.normal(jax.random.PRNGKey(e), (d + 1,)))
+        m_cap = np.asarray(
+            factors_lib.compute_inverse(jnp.asarray(captured), 0.001)
+        ) @ g
+        m_or = np.asarray(
+            factors_lib.compute_inverse(jnp.asarray(a_oracle), 0.001)
+        ) @ g
+        assert cos(m_cap, m_or) > 1 - 1e-5, (e, n_e)
+        checked += 1
+    assert checked >= 3
+
+    # G factors are oracle-normalized too: routed G must equal the
+    # shared-normalization G rescaled by EXACTLY T / n_e (non-routed rows
+    # have identically-zero cotangents, so only the normalization — the
+    # live-row count — differs between the two captures)
+    reg_plain = kfac_tpu.register_model(m, x)
+    run_plain = kfac_tpu.CurvatureCapture(reg_plain).value_stats_and_grad(
+        loss_fn
+    )
+    (_, _), _, stats_plain = run_plain(params, (x, None))
+    for e in range(n_experts):
+        n_e = int((idx == e).sum())
+        if n_e == 0:
+            continue
+        np.testing.assert_allclose(
+            np.asarray(stats.g[f'expert{e}_up']),
+            np.asarray(stats_plain.g[f'expert{e}_up']) * (t / n_e),
+            rtol=1e-4, atol=1e-7,
+        )
+
+
+def test_routed_layers_rejects_non_dense():
+    import flax.linen as nn
+    import pytest as _pytest
+
+    class ConvNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Conv(4, (3, 3), name='c1')(x)
+
+    x = jnp.zeros((1, 8, 8, 3))
+    with _pytest.raises(ValueError, match='not a dense layer'):
+        kfac_tpu.register_model(ConvNet(), x, routed_layers=['c1'])
+
+
+def test_routed_layers_rejects_unmatched_pattern():
+    """A typo'd routed pattern must error, not silently fall back to the
+    approximate capture."""
+    import pytest as _pytest
+
+    m = moe.MoEMLP(num_experts=2, mlp_ratio=1)
+    x = jnp.zeros((1, 8, 4))
+    with _pytest.raises(ValueError, match='matched no registered layer'):
+        kfac_tpu.register_model(
+            m, x, routed_layers=[r'.*expert\d+_(upp|dwn)']  # typo'd suffixes
+        )
