@@ -267,9 +267,14 @@ _VMEM_KV_BYTES = 8 * 1024 * 1024
 
 def use_flash_for(s_q: int, s_k: int, d: int, itemsize: int = 4) -> bool:
     """Dispatch heuristic: the kernel needs whole lane-aligned tiles, and
-    the staged K+V chunks must fit the VMEM budget."""
+    the staged K+V chunks must fit the VMEM budget. Gated behind
+    ``KFAC_TPU_PALLAS`` until validated on a real chip
+    (:mod:`kfac_tpu.ops.pallas_gate`)."""
+    from kfac_tpu.ops import pallas_gate
+
     return (
-        jax.default_backend() == 'tpu'
+        pallas_gate.enabled('attn')
+        and jax.default_backend() == 'tpu'
         and s_q % BLOCK_Q == 0
         and s_k % BLOCK_K == 0
         and d % 128 == 0

