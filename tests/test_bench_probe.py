@@ -114,28 +114,69 @@ def test_probe_rc1_unavailable_is_retryable(scripted):
 
 
 def test_persist_writes_partial_snapshot(tmp_path, monkeypatch):
-    """_persist leaves an atomic JSON snapshot flagged partial=True, so a
-    killed run's completed phases survive on disk."""
+    """_persist leaves an atomic JSON snapshot flagged partial=True (plus
+    this run's id for attribution), so a killed run's completed phases
+    survive on disk."""
     import json
 
     path = tmp_path / 'part.json'
     monkeypatch.setenv('BENCH_PARTIAL_PATH', str(path))
+    monkeypatch.setenv('BENCH_RUNS_DIR', str(tmp_path / 'runs'))
     bench._persist({'metric': 'm', 'value': 1.5})
     got = json.loads(path.read_text())
-    assert got == {'metric': 'm', 'value': 1.5, 'partial': True}
-    # completed runs re-stamp partial=False; a fresh run clears stale files
+    assert got == {
+        'metric': 'm', 'value': 1.5, 'partial': True, 'run_id': bench._RUN_ID
+    }
+    # completed runs re-stamp partial=False
     bench._persist({'metric': 'm', 'value': 1.5}, partial=False)
     assert json.loads(path.read_text())['partial'] is False
-    bench._clear_partial()
-    assert not path.exists()
     # overwrite is atomic (no stale tmp files left behind)
     bench._persist({'metric': 'm', 'value': 2.5})
     assert json.loads(path.read_text())['value'] == 2.5
     assert list(tmp_path.glob('*.tmp.*')) == []
+    # the per-run record carries the same payload, keyed by run id
+    run_file = tmp_path / 'runs' / f'run_{bench._RUN_ID}.json'
+    assert json.loads(run_file.read_text())['value'] == 2.5
+
+
+def test_persist_never_clobbers_tpu_record_with_cpu(tmp_path, monkeypatch):
+    """The round-4 data-loss: a CPU-fallback run overwrote the only TPU
+    measurement of the round. The latest-pointer now refuses that write;
+    the CPU run's own numbers land in its per-run file instead."""
+    import json
+
+    path = tmp_path / 'part.json'
+    monkeypatch.setenv('BENCH_PARTIAL_PATH', str(path))
+    monkeypatch.setenv('BENCH_RUNS_DIR', str(tmp_path / 'runs'))
+    path.write_text(json.dumps(
+        {'platform': 'tpu', 'value': 123.0, 'run_id': 'older_tpu_run'}
+    ))
+    bench._persist({'metric': 'm', 'platform': 'cpu', 'value': 1.0})
+    kept = json.loads(path.read_text())
+    assert kept['platform'] == 'tpu' and kept['value'] == 123.0
+    run_file = tmp_path / 'runs' / f'run_{bench._RUN_ID}.json'
+    assert json.loads(run_file.read_text())['platform'] == 'cpu'
+    # a TPU-platform result DOES refresh the pointer
+    bench._persist({'metric': 'm', 'platform': 'tpu', 'value': 2.0})
+    assert json.loads(path.read_text())['value'] == 2.0
+
+
+def test_mark_run_started_stamps_latest(tmp_path, monkeypatch):
+    """Attribution marker: bench_partial.json describes the current run iff
+    its run_id matches LATEST.json (the pointer can lag after the clobber
+    guard or a pre-first-phase death)."""
+    import json
+
+    monkeypatch.setenv('BENCH_PARTIAL_PATH', str(tmp_path / 'part.json'))
+    monkeypatch.setenv('BENCH_RUNS_DIR', str(tmp_path / 'runs'))
+    bench._mark_run_started()
+    latest = json.loads((tmp_path / 'runs' / 'LATEST.json').read_text())
+    assert latest['run_id'] == bench._RUN_ID
 
 
 def test_persist_disabled_with_empty_path(tmp_path, monkeypatch):
     monkeypatch.setenv('BENCH_PARTIAL_PATH', '')
     monkeypatch.chdir(tmp_path)
     bench._persist({'metric': 'm'})
+    bench._mark_run_started()
     assert list(tmp_path.iterdir()) == []

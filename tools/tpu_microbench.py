@@ -44,6 +44,13 @@ def timeit(fn, *args, iters=20, warmup=1):
     return (time.perf_counter() - t0) / iters
 
 
+def announce(name):
+    """Pre-announce each measurement on stderr: when a TPU program wedges
+    mid-op, the last announced line names the culprit (the round-4 bench
+    died silently at an unnamed compile — never again)."""
+    print(f'[micro] timing {name}', file=sys.stderr, flush=True)
+
+
 def report(name, seconds, **extra):
     print(json.dumps({'op': name, 'ms': round(seconds * 1e3, 3), **extra}),
           flush=True)
@@ -275,12 +282,23 @@ def main():
                    help='interleaved-1F1B schedule bubble fractions '
                    '(pure schedule math, no device work)')
     p.add_argument('--skip-factor-ops', action='store_true')
+    p.add_argument('--no-pallas', action='store_true',
+                   help='skip the Pallas kernels (cov + flash attention): '
+                   'measure only validated XLA ops — the safe first pass '
+                   'on an untested chip')
+    p.add_argument('--pallas-only', action='store_true',
+                   help='measure ONLY the Pallas kernels vs their XLA '
+                   'oracles (on-chip validation pass; run after the safe '
+                   'ops have succeeded)')
     args = p.parse_args()
 
     dev = jax.devices()[0]
     print(json.dumps({'platform': dev.platform,
                       'device_kind': getattr(dev, 'device_kind', '')}),
           flush=True)
+
+    run_pallas = not args.no_pallas
+    xla_ops = not args.pallas_only
 
     # --- clock validation: known-FLOPs matmul chain -----------------------
     n = 4096
@@ -294,6 +312,7 @@ def main():
             x = x @ a
         return x
 
+    announce('matmul4096_bf16_chain8')
     t = timeit(mm_chain, a, iters=args.iters)
     flops = 8 * 2 * n**3
     report('matmul4096_bf16_chain8', t, tflops=round(flops / t / 1e12, 1))
@@ -314,15 +333,17 @@ def main():
     dense_att = jax.jit(
         lambda q, k, v: att._finish(pa.attend_partials_einsum(q, k, v, 0, 0, True))
     )
+    announce(f'attn_einsum_s{s}')
     t = timeit(dense_att, *qkv, iters=args.iters)
     report(f'attn_einsum_s{s}', t)
-    if on_tpu:
+    if on_tpu and run_pallas:
         try:
             flash = jax.jit(
                 lambda q, k, v: att._finish(
                     pa.flash_attention_partials(q, k, v, causal=True)
                 )
             )
+            announce(f'attn_flash_s{s}')
             t2 = timeit(flash, *qkv, iters=args.iters)
             err = float(jnp.abs(
                 flash(*qkv).astype(jnp.float32)
@@ -340,42 +361,49 @@ def main():
                                   jnp.float32)
             cov = (m.T @ m) / args.rows  # SPD test matrix
 
-            f = jax.jit(lambda c: jnp.linalg.eigh(c))
-            t = timeit(f, cov, iters=max(3, args.iters // 4))
-            report(f'eigh_{d}', t)
+            if xla_ops:
+                f = jax.jit(lambda c: jnp.linalg.eigh(c))
+                announce(f'eigh_{d}')
+                t = timeit(f, cov, iters=max(3, args.iters // 4))
+                report(f'eigh_{d}', t)
 
-            # host-offloaded eigh (pure_callback -> LAPACK): the EIGEN
-            # method's TPU escape hatch — measures the d^2 transfer + host
-            # syevd against the device eigh above and Newton-Schulz below
-            from kfac_tpu.ops import factors as factors_lib
+                # host-offloaded eigh (pure_callback -> LAPACK): the EIGEN
+                # method's TPU escape hatch — measures the d^2 transfer +
+                # host syevd against the device eigh above and
+                # Newton-Schulz below
+                from kfac_tpu.ops import factors as factors_lib
 
-            fh = jax.jit(
-                lambda c: factors_lib.batched_eigh(c, impl='host')
-            )
-            t = timeit(fh, cov, iters=max(3, args.iters // 4))
-            report(f'eigh_host_{d}', t)
-
-            # cholesky factor + solve against identity (the INVERSE method)
-            def chol_inv(c):
-                l = jax.scipy.linalg.cho_factor(
-                    c + 0.003 * jnp.eye(d, dtype=c.dtype)
+                fh = jax.jit(
+                    lambda c: factors_lib.batched_eigh(c, impl='host')
                 )
-                return jax.scipy.linalg.cho_solve(
-                    l, jnp.eye(d, dtype=c.dtype)
-                )
+                announce(f'eigh_host_{d}')
+                t = timeit(fh, cov, iters=max(3, args.iters // 4))
+                report(f'eigh_host_{d}', t)
 
-            t = timeit(jax.jit(chol_inv), cov, iters=max(3, args.iters // 4))
-            report(f'cholesky_inv_{d}', t)
+                # cholesky factor + solve against identity (INVERSE method)
+                def chol_inv(c):
+                    l = jax.scipy.linalg.cho_factor(
+                        c + 0.003 * jnp.eye(d, dtype=c.dtype)
+                    )
+                    return jax.scipy.linalg.cho_solve(
+                        l, jnp.eye(d, dtype=c.dtype)
+                    )
 
-            # Newton-Schulz damped inverse: 2*iters MXU matmuls, the
-            # library's TPU default (default_compute_method)
-            ns = jax.jit(lambda c: newton_schulz_inverse(c, 0.003))
-            t = timeit(ns, cov, iters=max(3, args.iters // 4))
-            x = ns(cov)
-            err = float(jnp.abs(
-                x @ (cov + 0.003 * jnp.eye(d)) - jnp.eye(d)
-            ).max())
-            report(f'newton_schulz25_{d}', t, residual_inf=round(err, 6))
+                announce(f'cholesky_inv_{d}')
+                t = timeit(jax.jit(chol_inv), cov,
+                           iters=max(3, args.iters // 4))
+                report(f'cholesky_inv_{d}', t)
+
+                # Newton-Schulz damped inverse: 2*iters MXU matmuls, the
+                # library's TPU default (default_compute_method)
+                ns = jax.jit(lambda c: newton_schulz_inverse(c, 0.003))
+                announce(f'newton_schulz25_{d}')
+                t = timeit(ns, cov, iters=max(3, args.iters // 4))
+                x = ns(cov)
+                err = float(jnp.abs(
+                    x @ (cov + 0.003 * jnp.eye(d)) - jnp.eye(d)
+                ).max())
+                report(f'newton_schulz25_{d}', t, residual_inf=round(err, 6))
 
             # covariance: XLA dense contraction vs Pallas triangular kernel
             for dt, tag in ((jnp.float32, 'f32'), (jnp.bfloat16, 'bf16')):
@@ -386,24 +414,28 @@ def main():
                         preferred_element_type=jnp.float32,
                     ) / a.shape[0]
                 )
+                announce(f'cov_dense_{d}_{tag}')
                 t = timeit(dense, md, iters=args.iters)
                 report(f'cov_dense_{d}_{tag}', t)
-                try:
-                    from kfac_tpu.ops import pallas_cov
+                if run_pallas:
+                    try:
+                        from kfac_tpu.ops import pallas_cov
 
-                    t = timeit(
-                        jax.jit(lambda a: pallas_cov.sym_cov(a)), md,
-                        iters=args.iters,
-                    )
-                    got = pallas_cov.sym_cov(md)
-                    want = dense(md).astype(got.dtype)
-                    err = float(jnp.abs(
-                        got.astype(jnp.float32) - want.astype(jnp.float32)
-                    ).max())
-                    report(f'cov_pallas_{d}_{tag}', t, max_err=round(err, 5))
-                except Exception as exc:  # noqa: BLE001
-                    report(f'cov_pallas_{d}_{tag}', float('nan'),
-                           error=f'{type(exc).__name__}: {exc}')
+                        announce(f'cov_pallas_{d}_{tag}')
+                        t = timeit(
+                            jax.jit(lambda a: pallas_cov.sym_cov(a)), md,
+                            iters=args.iters,
+                        )
+                        got = pallas_cov.sym_cov(md)
+                        want = dense(md).astype(got.dtype)
+                        err = float(jnp.abs(
+                            got.astype(jnp.float32) - want.astype(jnp.float32)
+                        ).max())
+                        report(f'cov_pallas_{d}_{tag}', t,
+                               max_err=round(err, 5))
+                    except Exception as exc:  # noqa: BLE001
+                        report(f'cov_pallas_{d}_{tag}', float('nan'),
+                               error=f'{type(exc).__name__}: {exc}')
 
     if args.resnet:
         bench_resnet50_inverse_update(args.iters)
