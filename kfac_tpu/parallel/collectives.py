@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 # ---------------------------------------------------------------- triangles
@@ -70,4 +71,55 @@ def split_flat(
             .astype(dtype)
         )
         offset += size
+    return out
+
+
+def concat_flat_chunked(
+    tensors: list[jax.Array],
+    max_bytes: int | float | None = None,
+) -> list[tuple[jax.Array, list[tuple[tuple[int, ...], int, jnp.dtype]]]]:
+    """:func:`concat_flat` with a byte cap per buffer.
+
+    Greedy in-order packing: a new chunk starts when adding the next
+    tensor would push the current chunk past ``max_bytes`` (a single
+    tensor larger than the cap gets its own chunk — never split, as in
+    the reference's bucketed allreduce, kfac/distributed.py:305-374,
+    whose default cap is 25 MB). Capping bounds the transient memory of
+    the pack/unpack (one chunk's buffer live at a time instead of a
+    second copy of every factor) and keeps individual collectives inside
+    the comfortable message-size range of the interconnect. ``None``
+    packs everything into one buffer.
+    """
+    if max_bytes is None or not tensors:
+        return [concat_flat(tensors)]
+    chunks = []
+    cur: list[jax.Array] = []
+    cur_elems = 0
+    cur_dtype = None
+    for t in tensors:
+        # size at the PROMOTED dtype: concat_flat's buffer promotes mixed
+        # dtypes, so a bf16 triangle next to an f32 one occupies 4 bytes
+        # per element in the packed buffer, not 2
+        new_dtype = (
+            t.dtype if cur_dtype is None
+            else jnp.result_type(cur_dtype, t.dtype)
+        )
+        new_elems = cur_elems + int(t.size)
+        if cur and new_elems * np.dtype(new_dtype).itemsize > max_bytes:
+            chunks.append(concat_flat(cur))
+            cur = []
+            new_dtype, new_elems = t.dtype, int(t.size)
+        cur.append(t)
+        cur_elems, cur_dtype = new_elems, new_dtype
+    chunks.append(concat_flat(cur))
+    return chunks
+
+
+def split_flat_chunked(
+    chunks: list[tuple[jax.Array, list[tuple[tuple[int, ...], int, jnp.dtype]]]],
+) -> list[jax.Array]:
+    """Inverse of :func:`concat_flat_chunked` (original tensor order)."""
+    out: list[jax.Array] = []
+    for flat, specs in chunks:
+        out.extend(split_flat(flat, specs))
     return out

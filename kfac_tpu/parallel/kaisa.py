@@ -458,11 +458,21 @@ class DistributedKFAC:
                 m for sb in self.a_store for m in rows_a[sb.key]
             ] + [m for sb in self.g_store for m in rows_g[sb.key]]
             tris = [collectives.get_triu(m) for m in flat_rows]
-            flat, specs = collectives.concat_flat(tris)
-            flat = jax.lax.with_sharding_constraint(flat, rep)
+            # byte-capped chunks (reference 25 MB default): bounds the
+            # transient pack footprint and the per-collective message size
+            cap = cfg.allreduce_bucket_cap_mb
+            chunks = [
+                (jax.lax.with_sharding_constraint(flat, rep), specs)
+                for flat, specs in collectives.concat_flat_chunked(
+                    tris,
+                    max_bytes=None if cap is None else cap * 1e6,
+                )
+            ]
             unpacked = iter(
                 collectives.fill_triu(m.shape, t)
-                for m, t in zip(flat_rows, collectives.split_flat(flat, specs))
+                for m, t in zip(
+                    flat_rows, collectives.split_flat_chunked(chunks)
+                )
             )
             for sb in self.a_store:  # same order as flat_rows: a then g
                 rows_a[sb.key] = [next(unpacked) for _ in rows_a[sb.key]]

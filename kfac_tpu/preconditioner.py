@@ -189,6 +189,13 @@ class KFACPreconditioner:
     # 422-465) for DCN-bound multihost meshes. Ignored by the dense engine
     # (no transport).
     allreduce_method: enums.AllreduceMethod = enums.AllreduceMethod.ALLREDUCE
+    # Byte cap per packed buffer under ALLREDUCE_BUCKETED, in MB (the
+    # reference's bucket cap, default 25 MB, kfac/distributed.py:305-374).
+    # Bounds the transient pack/unpack footprint on large models — without
+    # a cap, one buffer holds a second copy of every factor triangle at
+    # once — and keeps each collective inside the interconnect's
+    # comfortable message size. None = unbounded (single buffer).
+    allreduce_bucket_cap_mb: float | None = 25.0
 
     def __post_init__(self) -> None:
         if isinstance(self.compute_method, str):
@@ -277,6 +284,14 @@ class KFACPreconditioner:
                     f'expected one of '
                     f'{[m.name.lower() for m in enums.AllreduceMethod]}'
                 ) from None
+        if (
+            self.allreduce_bucket_cap_mb is not None
+            and self.allreduce_bucket_cap_mb <= 0
+        ):
+            raise ValueError(
+                f'allreduce_bucket_cap_mb must be > 0 (or None for '
+                f'unbounded), got {self.allreduce_bucket_cap_mb}'
+            )
         if self.inverse_solver not in ('cholesky', 'newton_schulz', 'auto'):
             raise ValueError(
                 f'unknown inverse_solver {self.inverse_solver!r}; expected '

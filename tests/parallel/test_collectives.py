@@ -47,3 +47,50 @@ def test_concat_split_restores_mixed_dtypes():
     back = collectives.split_flat(flat, specs)
     assert back[0].dtype == jnp.bfloat16
     assert back[1].dtype == jnp.float32
+
+
+def test_concat_flat_chunked_respects_byte_cap():
+    """Greedy in-order packing under a byte cap (the reference's 25 MB
+    bucket cap, kfac/distributed.py:305-374): chunk boundaries respect the
+    cap, order is preserved, an oversized tensor gets its own chunk."""
+    tensors = [
+        jnp.full((25,), i, jnp.float32) for i in range(4)  # 100 B each
+    ]
+    chunks = collectives.concat_flat_chunked(tensors, max_bytes=200)
+    assert [c[0].size for c in chunks] == [50, 50]
+    back = collectives.split_flat_chunked(chunks)
+    for orig, rec in zip(tensors, back):
+        np.testing.assert_array_equal(np.asarray(orig), np.asarray(rec))
+
+    # an oversized tensor is never split — it rides alone
+    tensors = [jnp.ones((10,)), jnp.ones((100,)), jnp.ones((10,))]
+    chunks = collectives.concat_flat_chunked(tensors, max_bytes=64)
+    assert [c[0].size for c in chunks] == [10, 100, 10]
+    assert len(collectives.split_flat_chunked(chunks)) == 3
+
+
+def test_concat_flat_chunked_uncapped_and_empty():
+    tensors = [jnp.ones((3,)), jnp.zeros((2, 2))]
+    chunks = collectives.concat_flat_chunked(tensors, max_bytes=None)
+    assert len(chunks) == 1 and chunks[0][0].size == 7
+    # empty input: one empty chunk, splits to nothing
+    chunks = collectives.concat_flat_chunked([], max_bytes=128)
+    assert len(chunks) == 1
+    assert collectives.split_flat_chunked(chunks) == []
+
+
+def test_concat_flat_chunked_sizes_at_promoted_dtype():
+    """Mixed-dtype packing promotes in the buffer (concat_flat), so the
+    cap must be applied at the PROMOTED size: 25 bf16 elems next to 25 f32
+    elems cost 50*4 B packed, not 25*2 + 25*4."""
+    tensors = [
+        jnp.ones((25,), jnp.bfloat16),   # 100 B packed at f32
+        jnp.ones((25,), jnp.float32),    # 100 B
+        jnp.ones((25,), jnp.bfloat16),   # 100 B packed at f32
+    ]
+    # naive (pre-promotion) sizing would fit the first two in a 180 B cap
+    # (50+100); promoted sizing (100+100) must split them
+    chunks = collectives.concat_flat_chunked(tensors, max_bytes=180)
+    assert [c[0].size for c in chunks] == [25, 25, 25]
+    back = collectives.split_flat_chunked(chunks)
+    assert [b.dtype for b in back] == [jnp.bfloat16, jnp.float32, jnp.bfloat16]

@@ -292,6 +292,51 @@ def test_bucketed_allreduce_matches_default():
         )
 
 
+def test_bucketed_allreduce_chunked_matches_default():
+    """A byte cap small enough to force one chunk per factor triangle must
+    not change the numerics — only the packing granularity (the
+    reference's 25 MB cap, kfac/distributed.py:305-374)."""
+
+    def run(**kw):
+        mesh, m, params, batch, reg, cfg, dk, loss_fn = _setup(
+            0.5, kl_clip=0.001, damping=0.01,
+            factor_update_steps=1, inv_update_steps=1, **kw,
+        )
+        cap = kfac_tpu.CurvatureCapture(reg)
+        runner = cap.value_stats_and_grad(loss_fn)
+        state = dk.init()
+
+        @jax.jit
+        def step(params, state, batch):
+            (l, _), grads, stats = runner(params, batch)
+            state, pg = dk.step(state, grads, stats)
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - 0.05 * g, params, pg
+            )
+            return params, state, l
+
+        bs = batch_sharding(mesh)
+        batch = tuple(jax.device_put(b, bs) for b in batch)
+        for _ in range(3):
+            params, state, l = step(params, state, batch)
+        return float(l), params
+
+    l_def, p_def = run(allreduce_method='allreduce')
+    # ~100-byte cap: every factor triangle in this model exceeds it, so
+    # each rides its own chunk — maximal chunking
+    l_c, p_c = run(
+        allreduce_method='allreduce_bucketed',
+        allreduce_bucket_cap_mb=1e-4,
+    )
+    np.testing.assert_allclose(l_c, l_def, rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p_def), jax.tree_util.tree_leaves(p_c)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
+
+
 @pytest.mark.parametrize('method', ['eigen', 'inverse'])
 def test_colocate_factors_false_placement_and_numerics(method):
     """colocate_factors=False stores A and G in independent dimension
