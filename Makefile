@@ -1,0 +1,43 @@
+# CI entry points — the counterpart of the reference's tox.ini
+# (/root/reference/tox.ini:1-21) for a non-pip-installed JAX library.
+#
+# Two tiers (pyproject.toml markers):
+#   test-fast  pre-commit tier: `-m 'not slow'`
+#   test       full suite — measured 7:45 warm-cache on a 1-core host,
+#              inside the reference's 15-minute CI budget
+#              (.github/workflows/tests.yml:12)
+#
+# All targets pin the host platform (the 8-virtual-device CPU mesh the
+# suite is written against) and scrub the axon TPU plugin registration,
+# which would otherwise hang the first jax.devices() on tunnel-equipped
+# hosts.
+
+PY ?= python
+TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+.PHONY: test test-fast test-unit test-integration bench bench-acc native
+
+test:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q
+
+test-fast:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q -m 'not slow'
+
+# unit/integration partition the suite for CI (the reference's
+# tests.yml + integration.yml split); `test` is the run-everything entry
+test-unit:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q --ignore=tests/integration
+
+test-integration:
+	$(TEST_ENV) $(PY) -m pytest tests/integration/ -q
+
+bench:
+	$(PY) bench.py
+
+bench-acc:
+	$(TEST_ENV) $(PY) tools/bench_accuracy.py
+
+# the loader self-builds (and caches) on first use; this just forces it
+native:
+	$(TEST_ENV) $(PY) -c "from kfac_tpu.utils.native_loader import _load_lib; _load_lib(); print('native/build/libkfacloader.so ok')"

@@ -155,7 +155,28 @@ def lm_corpus(
                 import json
 
                 with open(vpath) as f:
-                    vocab = int(json.load(f)['size'])
+                    meta = json.load(f)
+                vocab = int(meta['size'])
+                # a stale/hand-edited vocab.json smaller than the corpus'
+                # ids would make out-of-range targets one_hot to all-zero
+                # rows — the fused NLL silently degrades to bare logsumexp
+                # instead of erroring. tokenize_corpus.py writes max_token,
+                # making the check O(1); a vocab.json WITHOUT it is by
+                # definition not the tokenizer's output, so it pays one
+                # validating pass over the memmap (the cost the sidecar
+                # normally avoids).
+                max_tok = (
+                    int(meta['max_token'])
+                    if 'max_token' in meta
+                    else int(toks.max())
+                )
+                if vocab <= max_tok:
+                    raise ValueError(
+                        f'vocab.json size={vocab} but {path} contains token '
+                        f'id {max_tok}; vocab.json must be the tokenizer\'s '
+                        f'own output (tools/tokenize_corpus.py writes a '
+                        f'consistent pair)'
+                    )
             else:
                 vocab = int(toks.max()) + 1  # one full scan, no RAM copy
             return toks, vocab

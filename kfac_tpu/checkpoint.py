@@ -76,8 +76,18 @@ def _layout_view(man: dict[str, Any]) -> dict[str, Any]:
     return {k: man[k] for k in _LAYOUT_KEYS if k in man}
 
 
-def _manifest_path(path: str) -> str:
-    return os.path.abspath(path) + '.manifest.json'
+def _manifest_path(path: str) -> str | None:
+    """Local sidecar path for the layout manifest, or ``None`` for remote
+    URIs (``gs://``, ``s3://``, ...): ``os.path.abspath`` would mangle the
+    scheme and the builtin ``open`` cannot write there — orbax handles the
+    checkpoint itself through its own path layer, but the sidecar is
+    plain-file IO. Remote saves skip the manifest with a warning (restore
+    then runs manifest-less: same-layout restores work, cross-layout
+    migration is unavailable)."""
+    p = str(path)
+    if '://' in p:
+        return None
+    return os.path.abspath(p) + '.manifest.json'
 
 
 def _factors_from_saved(
@@ -155,13 +165,23 @@ def save(
     ckptr.save(path, payload)
     ckptr.wait_until_finished()
     if jax.process_index() == 0:
+        mpath = _manifest_path(path)
         if engine is not None:
-            with open(_manifest_path(path), 'w') as f:
-                json.dump(layout_manifest(engine), f, indent=1)
-        elif os.path.exists(_manifest_path(path)):
+            if mpath is None:
+                _warnings.warn(
+                    f'checkpoint path {path!r} is a remote URI: the layout '
+                    f'manifest sidecar is plain-file IO and is skipped — '
+                    f'cross-layout factor migration will be unavailable '
+                    f'for this checkpoint',
+                    stacklevel=2,
+                )
+            else:
+                with open(mpath, 'w') as f:
+                    json.dump(layout_manifest(engine), f, indent=1)
+        elif mpath is not None and os.path.exists(mpath):
             # a stale sidecar from an earlier save at this path would make
             # restore slice the NEW payload with the OLD layout
-            os.remove(_manifest_path(path))
+            os.remove(mpath)
 
 
 def restore(
@@ -194,7 +214,7 @@ def restore(
 
     saved_man = None
     mpath = _manifest_path(path)
-    if os.path.exists(mpath):
+    if mpath is not None and os.path.exists(mpath):
         with open(mpath) as f:
             saved_man = json.load(f)
     if saved_man is not None:

@@ -170,6 +170,14 @@ def routed_linear_a_factor(
     floors at one). The covariance still rides :func:`get_cov` (Pallas
     on TPU); the correction is one mask reduction plus a scalar rescale.
 
+    Caveat (same as :func:`routed_linear_g_factor`'s): a ROUTED token
+    whose layer input is exactly all-zero — e.g. a fully-dead ReLU hidden
+    vector feeding an expert down-projection — is indistinguishable from
+    an unrouted row, so it is miscounted as unrouted AND loses its
+    bias-ones contribution. With saturating/sparse activations the A-side
+    live count can therefore undercount; the resulting overnormalization
+    is bounded by 1/n_live per such row.
+
     Exactness scope: PER CAPTURE. Across captures the engines follow the
     standard K-FAC convention of averaging per-batch-normalized factors
     (EMA over steps; mean over grad-accumulation micro-steps), so the

@@ -63,17 +63,39 @@ def batched_eigh(
     the KAISA work division. ``pure_callback`` makes NO ordering guarantee
     (XLA may reorder, batch, or elide calls) — safe here precisely because
     the callback is pure; never add host-side state to it.
+
+    ``impl='eig_host'``: the NON-symmetric escape hatch — a general
+    ``numpy.linalg.eig`` on the host with real parts taken and eigenpairs
+    sorted ascending, the reference's ``symmetric=False`` handling for
+    factors that drift numerically non-symmetric
+    (kfac/layers/eigen.py:295-348, ``torch.linalg.eig`` real-part). In
+    this framework factors are symmetric BY CONSTRUCTION (``get_cov``
+    symmetrizes; the Pallas kernel is exactly symmetric), so this exists
+    as a robustness corner, not a default: general eigenvectors are not
+    orthogonal, and the preconditioning formula uses ``q.T`` as the
+    approximate inverse exactly as the reference does. ``jnp.linalg.eig``
+    has no TPU lowering, so this path always rides the host callback.
     """
     f = factor.astype(jnp.float32)
-    if impl == 'host':
+    if impl in ('host', 'eig_host'):
         import numpy as np
 
-        def _host(m):
+        def _host_eigh(m):
             w, v = np.linalg.eigh(m)
             return np.asarray(w, np.float32), np.asarray(v, np.float32)
 
+        def _host_eig(m):
+            w, v = np.linalg.eig(m)
+            w, v = np.real(w), np.real(v)
+            order = np.argsort(w, axis=-1)
+            w = np.take_along_axis(w, order, -1)
+            v = np.take_along_axis(v, order[..., None, :], -1)
+            return np.ascontiguousarray(w, np.float32), np.ascontiguousarray(
+                v, np.float32
+            )
+
         return jax.pure_callback(
-            _host,
+            _host_eigh if impl == 'host' else _host_eig,
             (
                 jax.ShapeDtypeStruct(f.shape[:-1], jnp.float32),
                 jax.ShapeDtypeStruct(f.shape, jnp.float32),
@@ -82,7 +104,9 @@ def batched_eigh(
             vmap_method='expand_dims',
         )
     if impl != 'xla':
-        raise ValueError(f"unknown eigh impl {impl!r}: 'xla' or 'host'")
+        raise ValueError(
+            f"unknown eigh impl {impl!r}: 'xla', 'host', or 'eig_host'"
+        )
     return jnp.linalg.eigh(f)
 
 
@@ -94,8 +118,9 @@ def compute_eigh(
     """Eigendecompose a (symmetrized) factor in fp32, clamp eigvals >= 0.
 
     Reference: kfac/layers/eigen.py:295-348. ``impl`` selects the device
-    (``'xla'``) or host-offloaded (``'host'``) decomposition — see
-    :func:`batched_eigh`.
+    (``'xla'``), host-offloaded symmetric (``'host'``), or host-offloaded
+    general real-part (``'eig_host'``, the reference's ``symmetric=False``
+    escape hatch) decomposition — see :func:`batched_eigh`.
     """
     d, q = batched_eigh(factor, impl)
     return EigenDecomp(q=q.astype(inv_dtype), d=jnp.clip(d, 0.0).astype(inv_dtype))
@@ -159,6 +184,7 @@ def newton_schulz_inverse_info(
     inv_dtype: jnp.dtype = jnp.float32,
     max_iters: int = 40,
     tol: float = 1e-6,
+    differentiable: bool = False,
 ) -> NewtonSchulzInfo:
     """Tikhonov-damped inverse by Newton-Schulz — matmuls only, with a
     residual-based stopping rule and convergence diagnostics.
@@ -200,6 +226,15 @@ def newton_schulz_inverse_info(
     the reference (kfac/layers/inverse.py:186-213) with the hardware's
     preferred primitive. The batched form is just ``jax.vmap`` (all lanes
     run until the slowest lane's stopping rule fires).
+
+    Differentiability: ``lax.while_loop`` has no transpose rule, so the
+    default path is NOT reverse-differentiable — callers that
+    differentiate THROUGH the preconditioner (meta-learning on the K-FAC
+    step) must pass ``differentiable=True``, which runs a fixed
+    ``max_iters``-step ``lax.scan`` with ``where``-frozen lanes: identical
+    outputs (once a lane stops, nothing changes), reverse-mode works, but
+    every call pays all ``2 * max_iters`` matmuls regardless of early
+    convergence.
     """
     f = factor.astype(jnp.float32)
     d = f.shape[-1]
@@ -235,7 +270,26 @@ def newton_schulz_inverse_info(
     # computes from ``m``.
     mx0 = m @ x0
     init = (x0, mx0, residual(mx0), lam_max * 0.0 + jnp.inf, 0)
-    x, _, resid, _, k = jax.lax.while_loop(cond, body, init)
+    if differentiable:
+        # fixed-trip scan with where-frozen lanes: same outputs as the
+        # while_loop (frozen lanes never change), reverse-differentiable
+        def scan_body(carry, _):
+            x, mx, resid, prev, k = carry
+            active = (resid > tol) & (resid < prev)
+            x_new = x @ (2.0 * eye - mx)
+            mx_new = m @ x_new
+            x = jnp.where(active, x_new, x)
+            mx = jnp.where(active, mx_new, mx)
+            prev = jnp.where(active, resid, prev)
+            resid = jnp.where(active, residual(mx_new), resid)
+            k = k + active.astype(jnp.int32)
+            return (x, mx, resid, prev, k), None
+
+        (x, _, resid, _, k), _ = jax.lax.scan(
+            scan_body, init, None, length=max_iters
+        )
+    else:
+        x, _, resid, _, k = jax.lax.while_loop(cond, body, init)
     return NewtonSchulzInfo(
         inverse=x.astype(inv_dtype),
         residual=resid,
@@ -249,11 +303,14 @@ def newton_schulz_inverse(
     inv_dtype: jnp.dtype = jnp.float32,
     iters: int = 40,
     tol: float = 1e-6,
+    differentiable: bool = False,
 ) -> jax.Array:
     """Newton-Schulz damped inverse (see ``newton_schulz_inverse_info`` for
-    the iteration, stopping rule, and accuracy discussion)."""
+    the iteration, stopping rule, accuracy, and the ``differentiable``
+    fixed-trip variant for callers that differentiate through it)."""
     return newton_schulz_inverse_info(
-        factor, damping, inv_dtype, max_iters=iters, tol=tol
+        factor, damping, inv_dtype, max_iters=iters, tol=tol,
+        differentiable=differentiable,
     ).inverse
 
 

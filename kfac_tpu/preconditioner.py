@@ -143,10 +143,15 @@ class KFACPreconditioner:
     # ops/factors.damped_inverse for the vmap cost caveat).
     # None selects per platform (see default_compute_method).
     inverse_solver: str | None = None
-    # EIGEN-method decomposition backend: 'xla' (device eigh) or 'host'
+    # EIGEN-method decomposition backend: 'xla' (device eigh), 'host'
     # (jax.pure_callback to LAPACK on the host CPU — the escape hatch for
     # TPU, where the device eigh's compile alone is pathological; factors
-    # are small, so the transfer is cheap). See ops/factors.batched_eigh.
+    # are small, so the transfer is cheap), or 'eig_host' (general
+    # non-symmetric eig on the host, real parts — the reference's
+    # symmetric=False handling, kfac/layers/eigen.py:295-348, for factors
+    # that drift numerically non-symmetric; here factors are symmetric by
+    # construction, so this is a robustness corner only). See
+    # ops/factors.batched_eigh.
     eigh_impl: str = 'xla'
     # Iteration cap for the Newton-Schulz solver. The residual stopping
     # rule exits earlier on benign factors (~15 iterations at kappa 1e4);
@@ -237,16 +242,17 @@ class KFACPreconditioner:
                 return None
             return platform()
 
-        if self.eigh_impl not in ('xla', 'host'):
+        if self.eigh_impl not in ('xla', 'host', 'eig_host'):
             raise ValueError(
-                f"unknown eigh_impl {self.eigh_impl!r}; expected 'xla' or "
-                "'host'"
+                f"unknown eigh_impl {self.eigh_impl!r}; expected 'xla', "
+                "'host', or 'eig_host'"
             )
         if self.compute_method is None:
             self.compute_method = default_compute_method(platform())[0]
         elif (
             self.compute_method == enums.ComputeMethod.EIGEN
-            and self.eigh_impl != 'host'  # host offload sidesteps the hazard
+            # host offload (symmetric or general) sidesteps the hazard
+            and self.eigh_impl not in ('host', 'eig_host')
             and platform_if_initialized() == 'tpu'
         ):
             warnings.warn(

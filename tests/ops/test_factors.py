@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kfac_tpu.ops import factors
 
@@ -234,3 +235,81 @@ def test_gershgorin_condition_bound_bounds_true_condition():
     assert bound >= true_cond * 0.99, (bound, true_cond)
     # and it is not absurdly loose: within d * kappa
     assert bound <= true_cond * 32, (bound, true_cond)
+
+
+def test_eig_host_matches_eigh_on_symmetric():
+    """The non-symmetric escape hatch (reference kfac/layers/eigen.py:
+    295-348 symmetric=False, torch.linalg.eig real-part): on an actually
+    symmetric factor it must agree with eigh up to eigenvector sign."""
+    rng = np.random.default_rng(7)
+    m = rng.normal(size=(12, 6)).astype(np.float32)
+    cov = jnp.asarray(m.T @ m / 12)
+    d_ref, q_ref = factors.batched_eigh(cov, impl='host')
+    d_eig, q_eig = factors.batched_eigh(cov, impl='eig_host')
+    np.testing.assert_allclose(np.asarray(d_eig), np.asarray(d_ref),
+                               rtol=1e-4, atol=1e-5)
+    # eigenvectors match up to per-column sign
+    dots = np.abs(np.sum(np.asarray(q_eig) * np.asarray(q_ref), axis=0))
+    np.testing.assert_allclose(dots, np.ones(6), atol=1e-4)
+
+
+def test_eig_host_handles_nonsymmetric_real_parts():
+    """A factor that drifted numerically non-symmetric still decomposes
+    (real parts, ascending order) instead of silently assuming symmetry."""
+    rng = np.random.default_rng(8)
+    m = rng.normal(size=(10, 5)).astype(np.float32)
+    cov = m.T @ m / 10
+    skew = cov + 1e-3 * rng.normal(size=(5, 5)).astype(np.float32)
+    d, q = jax.jit(
+        lambda c: factors.batched_eigh(c, impl='eig_host')
+    )(jnp.asarray(skew))
+    d, q = np.asarray(d), np.asarray(q)
+    assert np.all(np.diff(d) >= 0)  # ascending, eigh convention
+    assert d.dtype == np.float32 and q.dtype == np.float32
+    # real-part eigenpairs still nearly diagonalize the nearly-symmetric
+    # factor: reconstruction error at the perturbation scale
+    recon = q @ np.diag(d) @ np.linalg.inv(q)
+    assert np.abs(recon - skew).max() < 1e-2
+
+
+def test_batched_eigh_rejects_unknown_impl():
+    with pytest.raises(ValueError):
+        factors.batched_eigh(jnp.eye(3), impl='cuda')
+
+
+def test_newton_schulz_differentiable_variant():
+    """The fixed-trip scan variant matches the while_loop outputs and is
+    reverse-differentiable (the while_loop path has no transpose rule)."""
+    rng = np.random.default_rng(9)
+    m = rng.normal(size=(32, 8)).astype(np.float32)
+    cov = jnp.asarray(m.T @ m / 32)
+    info_w = factors.newton_schulz_inverse_info(cov, 0.01)
+    info_s = factors.newton_schulz_inverse_info(cov, 0.01, differentiable=True)
+    np.testing.assert_allclose(
+        np.asarray(info_s.inverse), np.asarray(info_w.inverse),
+        rtol=1e-6, atol=1e-7,
+    )
+    assert int(info_s.iterations) == int(info_w.iterations)
+    np.testing.assert_allclose(
+        float(info_s.residual), float(info_w.residual), rtol=1e-5, atol=1e-8
+    )
+
+    # reverse mode works through the scan variant...
+    def loss(c):
+        return jnp.sum(
+            factors.newton_schulz_inverse(c, 0.01, differentiable=True)
+        )
+
+    g = jax.grad(loss)(cov)
+    assert np.all(np.isfinite(np.asarray(g)))
+    # ...and the gradient is correct: d/dc sum(inv(c+dI)) via the identity
+    # d(M^-1) = -M^-1 dM M^-1  =>  grad = -(M^-T 1 M^-T)
+    inv = np.linalg.inv(np.asarray(cov) + 0.01 * np.eye(8))
+    expected = -(inv.T @ np.ones((8, 8)) @ inv.T)
+    np.testing.assert_allclose(np.asarray(g), expected, rtol=1e-3, atol=1e-4)
+
+    # the while_loop path indeed cannot transpose (documents the contract)
+    with pytest.raises(Exception):
+        jax.grad(
+            lambda c: jnp.sum(factors.newton_schulz_inverse(c, 0.01))
+        )(cov)

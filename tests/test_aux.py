@@ -525,3 +525,36 @@ def test_factor_checkpoint_moves_between_engine_configs(tmp_path):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(c), rtol=2e-3, atol=1e-5
         )
+
+
+def test_manifest_path_skips_remote_uris():
+    """Remote checkpoint URIs have no plain-file sidecar: _manifest_path
+    must return None (os.path.abspath would mangle the scheme and open()
+    cannot write there) so save warns-and-skips instead of crashing and
+    restore proceeds manifest-less."""
+    from kfac_tpu import checkpoint
+
+    assert checkpoint._manifest_path('gs://bucket/ckpt/step_5') is None
+    assert checkpoint._manifest_path('s3://bucket/x') is None
+    local = checkpoint._manifest_path('/tmp/ckpt/step_5')
+    assert local == '/tmp/ckpt/step_5.manifest.json'
+
+
+def test_lm_corpus_rejects_undersized_vocab_json(tmp_path):
+    """A stale/hand-edited vocab.json smaller than max(token)+1 must error
+    loudly: out-of-range targets would otherwise one_hot to all-zero rows
+    and silently turn the fused NLL into bare logsumexp."""
+    import json as json_lib
+
+    import pytest
+
+    from examples import data
+
+    np.save(tmp_path / 'corpus.npy', np.array([0, 1, 2, 9], np.int32))
+    (tmp_path / 'vocab.json').write_text(json_lib.dumps({'size': 5}))
+    with pytest.raises(ValueError, match='vocab.json size=5'):
+        data.lm_corpus(data_dir=str(tmp_path))
+    # a consistent vocab loads fine
+    (tmp_path / 'vocab.json').write_text(json_lib.dumps({'size': 10}))
+    toks, vocab = data.lm_corpus(data_dir=str(tmp_path))
+    assert vocab == 10 and int(toks.max()) == 9

@@ -106,7 +106,12 @@ def main(argv=None) -> None:
     out_path = os.path.join(args.out_dir, 'corpus.npy')
     encode_to_npy(args.text, out_path, itos, n_tokens, lower)
     with open(os.path.join(args.out_dir, 'vocab.json'), 'w') as f:
-        json.dump({'size': len(itos), 'itos': itos}, f)
+        # max_token lets lm_corpus validate size > max(token id) in O(1)
+        # instead of scanning the memmap (ids are 0..size-1 by
+        # construction here, so the pair is consistent forever)
+        json.dump(
+            {'size': len(itos), 'itos': itos, 'max_token': len(itos) - 1}, f
+        )
     print(f'{n_tokens} tokens, vocab {len(itos)} -> {out_path}')
 
 
