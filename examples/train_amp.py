@@ -1,0 +1,158 @@
+"""Mixed-precision (float16) K-FAC training with dynamic loss scaling.
+
+End-to-end AMP flow (reference parity: examples/vision/engine.py:80-88,
+torch.cuda.amp.GradScaler + KFAC grad-scale unscaling):
+
+- model computes in float16 (params stay float32 masters — flax
+  ``param_dtype`` default), K-FAC factors/inverses in float32;
+- the loss is multiplied by the scaler's scale BEFORE backward, so fp16
+  cotangents sit in representable range;
+- gradients AND captured K-FAC statistics are unscaled afterwards
+  (``CapturedStats.scaled`` divides G by scale**2 — G is quadratic in
+  the cotangents, kfac/layers/base.py:365-366);
+- an inf/nan anywhere in the grads skips the step INSIDE the compiled
+  program (``lax.cond`` — no host round-trip) and halves the scale; the
+  K-FAC step counter does not advance on skipped steps;
+- after ``--growth-interval`` consecutive good steps the scale doubles,
+  so a short run exercises the full overflow/recovery cycle against the
+  fp16 max of 65504 — REAL overflows, not injected ones.
+
+On TPU prefer plain bfloat16 (fp32 exponent range, no scaling needed);
+this example is the fp16 semantics the reference's AMP engine implements,
+plus the overflow-robustness exercise.
+
+Usage:
+    python examples/train_amp.py --steps 300 --growth-interval 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+
+sys.path.insert(0, '.')  # repo root
+import flax.linen as nn
+
+import kfac_tpu
+from examples import common, data
+from kfac_tpu import amp
+
+
+class ConvNet(nn.Module):
+    """Small BN-free CIFAR CNN computing in ``dtype`` (fp16 here)."""
+
+    dtype: jnp.dtype = jnp.float16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), strides=(2, 2), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), strides=(2, 2), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(10, dtype=self.dtype)(x)
+
+
+def build_step(model, kfac, opt, registry):
+    """One jitted AMP train step: capture under the scaled loss, unscale
+    grads+stats, lax.cond between apply and skip, adapt the scaler."""
+
+    def scaled_loss(params, batch_and_scale):
+        (xb, yb), scale = batch_and_scale
+        logits = model.apply({'params': params}, xb)
+        # loss math in fp32 (logits upcast); the SCALE rides the loss so
+        # the fp16 backward through the network sees scaled cotangents
+        return common.cross_entropy_loss(logits.astype(jnp.float32), yb, 10) * scale
+
+    cap = kfac_tpu.CurvatureCapture(registry)
+    run = cap.value_stats_and_grad(scaled_loss)
+
+    @jax.jit
+    def step(params, kstate, opt_state, scaler, batch, growth_interval):
+        (l_scaled, _), grads, stats = run(params, (batch, scaler.scale))
+        finite = amp.all_finite(grads)
+
+        def apply(_):
+            g = amp.unscale(grads, scaler.scale)
+            st = stats.scaled(scaler.scale)
+            kst, pg = kfac.step(kstate, g, st)
+            updates, ost = opt.update(pg, opt_state, params)
+            return optax.apply_updates(params, updates), kst, ost
+
+        def skip(_):
+            # poisoned grads/stats dropped; K-FAC step counter unchanged
+            # (the in-jit analogue of Trainer.reset_batch's host-side drop)
+            return params, kstate, opt_state
+
+        params2, kstate2, opt_state2 = jax.lax.cond(finite, apply, skip, None)
+        scaler2 = amp.update(scaler, finite, growth_interval=growth_interval)
+        return (
+            params2, kstate2, opt_state2, scaler2,
+            l_scaled / scaler.scale, finite,
+        )
+
+    return step
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description='fp16 AMP + K-FAC')
+    p.add_argument('--steps', type=int, default=300)
+    p.add_argument('--batch-size', type=int, default=128)
+    p.add_argument('--lr', type=float, default=0.05)
+    p.add_argument('--init-scale', type=float, default=2.0**16)
+    p.add_argument('--growth-interval', type=int, default=50)
+    p.add_argument('--data-dir', default=None)
+    p.add_argument('--seed', type=int, default=0)
+    args = p.parse_args(argv)
+
+    (x_train, y_train), _ = data.cifar10(args.data_dir, n_train=4096, n_test=256)
+    model = ConvNet()
+    sample = jnp.asarray(x_train[: args.batch_size])
+    params = model.init(jax.random.PRNGKey(args.seed), sample)['params']
+    registry = kfac_tpu.register_model(model, sample)
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=registry, damping=0.003, lr=args.lr,
+        factor_update_steps=1, inv_update_steps=10,
+    )
+    opt = optax.sgd(args.lr, momentum=0.9)
+    step = build_step(model, kfac, opt, registry)
+
+    kstate, opt_state = kfac.init(), opt.init(params)
+    scaler = amp.init(args.init_scale)
+    n = len(x_train) // args.batch_size
+    skipped = 0
+    for i in range(args.steps):
+        j = (i % n) * args.batch_size
+        batch = (
+            jnp.asarray(x_train[j : j + args.batch_size]),
+            jnp.asarray(y_train[j : j + args.batch_size]),
+        )
+        params, kstate, opt_state, scaler, loss, finite = step(
+            params, kstate, opt_state, scaler, batch, args.growth_interval
+        )
+        if not bool(finite):
+            skipped += 1
+            print(f'step {i}: OVERFLOW -> scale {float(scaler.scale):.0f}')
+        elif i % 25 == 0:
+            print(
+                f'step {i}: loss={float(loss):.4f} '
+                f'scale={float(scaler.scale):.0f} skipped={skipped}'
+            )
+    print(
+        f'done: loss={float(loss):.4f} scale={float(scaler.scale):.0f} '
+        f'skipped={skipped} kfac_steps={int(kstate.step)} of {args.steps}'
+    )
+    return float(loss), skipped, int(kstate.step)
+
+
+if __name__ == '__main__':
+    main()

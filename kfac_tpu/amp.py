@@ -1,0 +1,90 @@
+"""Dynamic loss scaling for mixed-precision K-FAC training.
+
+The functional, jit-native equivalent of the grad-scaler flow the
+reference rides through ``torch.cuda.amp`` (examples/vision/engine.py:
+80-88: scale the loss, unscale the grads, skip the step on inf/nan, let
+the scaler adapt): the scaler is a tiny pytree carried through the train
+step, overflow handling is a ``lax.cond`` INSIDE the compiled step (no
+host round-trip on the skip path — the TPU-native shape of "check then
+maybe step"), and the K-FAC statistics captured under the scaled loss are
+unscaled with :meth:`kfac_tpu.layers.capture.CapturedStats.scaled`
+(G is quadratic in the cotangents, so it divides by ``scale**2`` —
+reference kfac/layers/base.py:365-366).
+
+On TPU, bfloat16 shares float32's exponent range and needs NO loss
+scaling — prefer plain bf16 there. This module exists for float16
+pipelines (fp16 halves HBM traffic on some parts and matches the
+reference's AMP semantics) and for exercising overflow robustness
+end-to-end: see ``examples/train_amp.py`` and the host-side
+``Trainer.accumulate_microbatch`` / ``reset_batch`` flow for
+grad-accumulation loops that drop a poisoned accumulation.
+
+Default scale schedule matches torch.cuda.amp.GradScaler: init 2**16,
+backoff 0.5 on overflow, growth 2.0 after 2000 consecutive good steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GradScaler(NamedTuple):
+    """Dynamic loss-scale state (a pytree: carry it through jitted steps).
+
+    ``scale``: current loss multiplier (float32 scalar).
+    ``good_steps``: consecutive overflow-free steps since the last scale
+    change (int32 scalar).
+    """
+
+    scale: jax.Array
+    good_steps: jax.Array
+
+
+def init(init_scale: float = 2.0**16) -> GradScaler:
+    return GradScaler(
+        scale=jnp.asarray(init_scale, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+    )
+
+
+def all_finite(tree: Any) -> jax.Array:
+    """Scalar bool: every leaf of ``tree`` is free of inf/nan."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(
+        [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
+    ).all()
+
+
+def unscale(tree: Any, scale: jax.Array) -> Any:
+    """Divide every leaf by ``scale`` (gradients of a scaled loss)."""
+    inv = 1.0 / scale
+    return jax.tree_util.tree_map(lambda g: g * inv, tree)
+
+
+def update(
+    scaler: GradScaler,
+    finite: jax.Array,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    growth_interval: int = 2000,
+) -> GradScaler:
+    """Adapt the scale after a step: halve on overflow, double after
+    ``growth_interval`` consecutive good steps (torch GradScaler
+    semantics). jit-friendly — pure ``where`` arithmetic."""
+    good = scaler.good_steps + 1
+    grow = good >= growth_interval
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grow, scaler.scale * growth_factor, scaler.scale),
+        scaler.scale * backoff_factor,
+    )
+    new_good = jnp.where(finite & ~grow, good, 0)
+    return GradScaler(
+        scale=new_scale.astype(jnp.float32),
+        good_steps=new_good.astype(jnp.int32),
+    )
