@@ -1,6 +1,13 @@
 """Distributed execution: meshes, collectives, KAISA/TP/CP/PP engines."""
 
-from kfac_tpu.parallel import collectives, mesh, pipeline, tensor_parallel
+from kfac_tpu.parallel import (
+    collectives,
+    expert_parallel,
+    mesh,
+    pipeline,
+    tensor_parallel,
+)
+from kfac_tpu.parallel.expert_parallel import EPSwitchFFN
 from kfac_tpu.parallel.kaisa import DistKFACState, DistributedKFAC, build_buckets
 from kfac_tpu.parallel.mesh import (
     batch_sharding,
@@ -15,11 +22,13 @@ from kfac_tpu.parallel.pipeline import PipelinedLM, PipelineKFAC
 __all__ = [
     'DistKFACState',
     'DistributedKFAC',
+    'EPSwitchFFN',
     'PipelineKFAC',
     'PipelinedLM',
     'batch_sharding',
     'build_buckets',
     'collectives',
+    'expert_parallel',
     'kaisa_mesh',
     'mesh',
     'pipeline',

@@ -26,6 +26,7 @@ DATA_AXES = (GW_AXIS, COL_AXIS)
 MODEL_AXIS = 'model'
 SEQ_AXIS = 'seq'
 PIPE_AXIS = 'pipe'
+EXPERT_AXIS = 'expert'
 
 
 def kaisa_mesh(
@@ -48,9 +49,10 @@ def train_mesh(
     grad_worker_fraction: float = 1.0,
     model: int = 1,
     seq: int = 1,
+    expert: int = 1,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build a 4-axis training mesh (kfac_gw, kfac_col, model, seq).
+    """Build a training mesh (kfac_gw, kfac_col, model, seq[, expert]).
 
     The data-parallel world is the KAISA grid (first two axes); ``model``
     shards tensor-parallel weights (the reference's Megatron-style
@@ -61,15 +63,30 @@ def train_mesh(
     first two axes; factor storage and eigendecomposition work additionally
     shard over model/seq (see DistributedKFAC._factor_spec), while
     decomposition resident layouts replicate over them.
+
+    ``expert > 1`` appends an ``expert`` axis for expert parallelism:
+    experts (and their K-FAC factors) shard over it, tokens all-to-all to
+    their experts' devices and back (parallel/expert_parallel.py), and the
+    axis doubles as extra data parallelism for the non-MoE layers (tokens
+    shard over data+expert jointly — see :func:`token_sharding`). The axis
+    is only present when requested, so existing meshes are unchanged.
     """
     devices = list(devices if devices is not None else jax.devices())
     world = len(devices)
-    if world % (model * seq) != 0:
+    if world % (model * seq * expert) != 0:
         raise ValueError(
-            f'{world} devices not divisible by model*seq = {model * seq}'
+            f'{world} devices not divisible by model*seq*expert = '
+            f'{model * seq * expert}'
         )
-    dp = world // (model * seq)
+    dp = world // (model * seq * expert)
     workers = assignment_lib.grad_worker_count(dp, grad_worker_fraction)
+    if expert > 1:
+        grid = np.asarray(devices, dtype=object).reshape(
+            workers, dp // workers, model, seq, expert
+        )
+        return Mesh(
+            grid, (GW_AXIS, COL_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS)
+        )
     grid = np.asarray(devices, dtype=object).reshape(
         workers, dp // workers, model, seq
     )
@@ -114,17 +131,27 @@ def pipeline_mesh(
     return Mesh(grid, (PIPE_AXIS, GW_AXIS, COL_AXIS, MODEL_AXIS))
 
 
+def _batch_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Axes the batch dim shards over: the KAISA data axes, plus the
+    expert axis when present (EP groups double as data parallelism for
+    the non-MoE layers)."""
+    axes = DATA_AXES
+    if EXPERT_AXIS in mesh.shape:
+        axes = axes + (EXPERT_AXIS,)
+    return axes
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading batch dim over every device (pure data parallel)."""
-    return NamedSharding(mesh, P(DATA_AXES))
+    return NamedSharding(mesh, P(_batch_axes(mesh)))
 
 
 def token_sharding(mesh: Mesh) -> NamedSharding:
-    """(batch, seq, ...) arrays: batch over the data axes, sequence over the
-    seq axis (no-op when the mesh has no seq axis)."""
+    """(batch, seq, ...) arrays: batch over the data(+expert) axes,
+    sequence over the seq axis (no-op when the mesh has no seq axis)."""
     if SEQ_AXIS in mesh.shape:
-        return NamedSharding(mesh, P(DATA_AXES, SEQ_AXIS))
-    return NamedSharding(mesh, P(DATA_AXES))
+        return NamedSharding(mesh, P(_batch_axes(mesh), SEQ_AXIS))
+    return NamedSharding(mesh, P(_batch_axes(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
