@@ -8,6 +8,7 @@ from kfac_tpu.parallel import (
     tensor_parallel,
 )
 from kfac_tpu.parallel.expert_parallel import EPSwitchFFN
+from kfac_tpu.parallel.interleaved_scan import InterleavedPipelinedLM
 from kfac_tpu.parallel.kaisa import DistKFACState, DistributedKFAC, build_buckets
 from kfac_tpu.parallel.mesh import (
     batch_sharding,
@@ -23,6 +24,7 @@ __all__ = [
     'DistKFACState',
     'DistributedKFAC',
     'EPSwitchFFN',
+    'InterleavedPipelinedLM',
     'PipelineKFAC',
     'PipelinedLM',
     'batch_sharding',

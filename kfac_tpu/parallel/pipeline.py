@@ -143,11 +143,22 @@ class PipelinedLM:
             ExperimentalFeatureWarning,
             stacklevel=2,
         )
-        if self.schedule not in ('gpipe', '1f1b'):
+        if self.schedule not in ('gpipe', '1f1b', 'interleaved'):
             raise ValueError(
-                f"unknown schedule {self.schedule!r}: 'gpipe' or '1f1b'"
+                f"unknown schedule {self.schedule!r}: 'gpipe', '1f1b', or "
+                f"'interleaved'"
             )
-        self.n_stages = int(self.mesh.shape[PIPE_AXIS])
+        if self.schedule == 'interleaved' and self._chunks_per_rank() == 1:
+            raise ValueError(
+                "the 'interleaved' schedule requires "
+                'InterleavedPipelinedLM (parallel/interleaved_scan.py)'
+            )
+        # logical stage count: pipe ranks x chunks per rank (1 for this
+        # class; InterleavedPipelinedLM overrides _chunks_per_rank so the
+        # stage module/registry below are built ONCE with the right count)
+        self.n_stages = int(self.mesh.shape[PIPE_AXIS]) * (
+            self._chunks_per_rank()
+        )
         # Every non-pipe, non-model mesh axis is a data-parallel axis: the
         # batch shards over them and factor statistics reduce over them (the
         # reference's factor allreduce over the DP group,
@@ -196,6 +207,11 @@ class PipelinedLM:
             name: capture_lib._make_gtap(h)
             for name, h in self.stage_registry.layers.items()
         }
+
+    def _chunks_per_rank(self) -> int:
+        """Model chunks per pipeline rank (1 here; the interleaved
+        subclass returns ``virtual_chunks``)."""
+        return 1
 
     # ------------------------------------------------------------ params
 
@@ -1067,67 +1083,89 @@ class PipelineKFAC:
         do_inverses = step % _resolve(cfg.inv_update_steps, step) == 0
 
         def body(a, g, qa, qg, da, dg, sa, sg, stage_grads):
-            # everything here is stage-local: leading dim 1, squeezed
-            sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
-            a, g, qa, qg, da, dg, sa, sg = map(sq, (a, g, qa, qg, da, dg, sa, sg))
-            sgrads = sq(stage_grads)
-            new_a, new_g, new_qa, new_qg, new_da, new_dg = {}, {}, {}, {}, {}, {}
-            pre = {}
+            # stage-local views: leading dim = stages per rank (1 for the
+            # plain pipeline, virtual_chunks for the interleaved one —
+            # a static Python loop over local chunks keeps the per-stage
+            # math identical; the kl-clip sum spans all chunks of all
+            # ranks before any scaling)
+            local = next(iter(a.values())).shape[0]
+            per_ci: list[tuple] = []
             vg = jnp.zeros((), jnp.float32)
-            for li, name in enumerate(names):
-                h = helpers[name]
-                na_ = jax.lax.cond(
-                    do_factors,
-                    lambda _: factors_lib.ema_update(
-                        a[name], sa[name].astype(cfg.factor_dtype), alpha
-                    ),
-                    lambda _: a[name],
-                    None,
+            for ci in range(local):
+                sq = lambda t: jax.tree_util.tree_map(lambda x: x[ci], t)
+                a_c, g_c, qa_c, qg_c, da_c, dg_c, sa_c, sg_c = map(
+                    sq, (a, g, qa, qg, da, dg, sa, sg)
                 )
-                ng_ = jax.lax.cond(
-                    do_factors,
-                    lambda _: factors_lib.ema_update(
-                        g[name], sg[name].astype(cfg.factor_dtype), alpha
-                    ),
-                    lambda _: g[name],
-                    None,
-                )
-                new_a[name], new_g[name] = na_, ng_
-
-                compute = self._make_decomp(
-                    damping, na_, ng_,
-                    (qa[name], qg[name], da[name], dg[name]), li,
-                )
-                qa_, qg_, da_, dg_ = jax.lax.cond(
-                    do_inverses,
-                    compute,
-                    lambda _: (qa[name], qg[name], da[name], dg[name]),
-                    None,
-                )
-                new_qa[name], new_qg[name] = qa_, qg_
-                new_da[name], new_dg[name] = da_, dg_
-
-                path = self.registry.param_paths[name]
-                node = sgrads
-                for k in path:
-                    node = node[k]
-                gmat = h.grads_to_matrix(dict(node))
-                if self._eigen:
-                    pmat = factors_lib.eigen_preconditioned_grad(
-                        gmat,
-                        factors_lib.EigenDecomp(qa_, da_),
-                        factors_lib.EigenDecomp(qg_, dg_),
-                        damping,
+                sgrads = sq(stage_grads)
+                new_a, new_g = {}, {}
+                new_qa, new_qg, new_da, new_dg = {}, {}, {}, {}
+                pre = {}
+                for li, name in enumerate(names):
+                    h = helpers[name]
+                    na_ = jax.lax.cond(
+                        do_factors,
+                        lambda _: factors_lib.ema_update(
+                            a_c[name], sa_c[name].astype(cfg.factor_dtype),
+                            alpha,
+                        ),
+                        lambda _: a_c[name],
+                        None,
                     )
-                else:
-                    pmat = factors_lib.inverse_preconditioned_grad(
-                        gmat, qa_, qg_
+                    ng_ = jax.lax.cond(
+                        do_factors,
+                        lambda _: factors_lib.ema_update(
+                            g_c[name], sg_c[name].astype(cfg.factor_dtype),
+                            alpha,
+                        ),
+                        lambda _: g_c[name],
+                        None,
                     )
-                if cfg.kl_clip is not None:
-                    vg = vg + jnp.sum(
-                        pmat.astype(jnp.float32) * gmat.astype(jnp.float32)
-                    ) * (lr**2)
-                pre[name] = pmat
+                    new_a[name], new_g[name] = na_, ng_
+
+                    # round-robin owner over DP peers: offset by chunk so
+                    # multi-chunk ranks spread decompositions too
+                    compute = self._make_decomp(
+                        damping, na_, ng_,
+                        (qa_c[name], qg_c[name], da_c[name], dg_c[name]),
+                        ci * len(names) + li,
+                    )
+                    qa_, qg_, da_, dg_ = jax.lax.cond(
+                        do_inverses,
+                        compute,
+                        lambda _: (
+                            qa_c[name], qg_c[name], da_c[name], dg_c[name]
+                        ),
+                        None,
+                    )
+                    new_qa[name], new_qg[name] = qa_, qg_
+                    new_da[name], new_dg[name] = da_, dg_
+
+                    path = self.registry.param_paths[name]
+                    node = sgrads
+                    for k in path:
+                        node = node[k]
+                    gmat = h.grads_to_matrix(dict(node))
+                    if self._eigen:
+                        pmat = factors_lib.eigen_preconditioned_grad(
+                            gmat,
+                            factors_lib.EigenDecomp(qa_, da_),
+                            factors_lib.EigenDecomp(qg_, dg_),
+                            damping,
+                        )
+                    else:
+                        pmat = factors_lib.inverse_preconditioned_grad(
+                            gmat, qa_, qg_
+                        )
+                    if cfg.kl_clip is not None:
+                        vg = vg + jnp.sum(
+                            pmat.astype(jnp.float32)
+                            * gmat.astype(jnp.float32)
+                        ) * (lr**2)
+                    pre[name] = pmat
+                per_ci.append(
+                    (new_a, new_g, new_qa, new_qg, new_da, new_dg,
+                     sgrads, pre)
+                )
 
             if cfg.kl_clip is not None:
                 vg = jax.lax.psum(vg, PIPE_AXIS)
@@ -1137,21 +1175,32 @@ class PipelineKFAC:
             else:
                 scale = 1.0
 
-            out_grads = sgrads
-            for name in names:
-                h = helpers[name]
-                new_leaves = h.matrix_to_grads(pre[name] * scale)
-                out_grads = registry_lib.merge_layer_grads(
-                    out_grads, {name: new_leaves},
-                    registry_lib.Registry(
-                        layers={name: h},
-                        param_paths={name: self.registry.param_paths[name]},
-                    ),
+            out_per_ci = []
+            for new_a, new_g, new_qa, new_qg, new_da, new_dg, sgrads, pre \
+                    in per_ci:
+                out_grads = sgrads
+                for name in names:
+                    h = helpers[name]
+                    new_leaves = h.matrix_to_grads(pre[name] * scale)
+                    out_grads = registry_lib.merge_layer_grads(
+                        out_grads, {name: new_leaves},
+                        registry_lib.Registry(
+                            layers={name: h},
+                            param_paths={
+                                name: self.registry.param_paths[name]
+                            },
+                        ),
+                    )
+                out_per_ci.append(
+                    (new_a, new_g, new_qa, new_qg, new_da, new_dg,
+                     out_grads)
                 )
-            ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-            return (
-                ex(new_a), ex(new_g), ex(new_qa), ex(new_qg),
-                ex(new_da), ex(new_dg), ex(out_grads),
+            stack = lambda *ts: jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *ts
+            )
+            return tuple(
+                stack(*(out_per_ci[ci][j] for ci in range(local)))
+                for j in range(7)
             )
 
         # 8 stage-sharded dict specs: a, g, qa, qg, da, dg, stats.a, stats.g

@@ -94,3 +94,79 @@ def test_rejects_invalid_configs():
         interleaved.generate(4, 2, 6)
     with pytest.raises(ValueError, match='chunks'):
         interleaved.generate(2, 0, 4)
+
+
+@pytest.mark.parametrize('p,v,m', [(2, 1, 2), (2, 2, 4), (4, 2, 8), (4, 4, 16), (8, 2, 16)])
+def test_single_slot_schedule_is_valid(p, v, m):
+    """Single-slot tables: dependency order, one op per rank per tick, op
+    counts, residual-slot pairing, and inbox-depth claims all hold."""
+    s = interleaved.generate_single_slot(p, v, m)
+    last = p * v - 1
+    f_done, b_done = {}, {}
+    slot_of = {}
+    stored = [set() for _ in range(p)]
+    act_live, cot_live = {}, {}
+    nf = nb = 0
+    for t in range(s.ticks):
+        consumed = []
+        produced = []
+        for r in range(p):
+            kind, c, mb, slot = (int(x) for x in s.ops[t, r])
+            if kind < 0:
+                continue
+            stage = c * p + r
+            if kind == 0:
+                if stage > 0:
+                    assert f_done[(stage - 1, mb)] < t, (t, r, stage, mb)
+                    consumed.append(('a', r, c))
+                # residual slot free and inside the ring
+                assert 0 <= slot < s.ring
+                assert slot not in stored[r], (t, r, slot)
+                stored[r].add(slot)
+                slot_of[(stage, mb)] = slot
+                f_done[(stage, mb)] = t
+                if stage < last:
+                    produced.append(('a', (stage + 1) % p, (stage + 1) // p))
+                nf += 1
+            else:
+                assert f_done[(stage, mb)] < t
+                if stage < last:
+                    assert b_done[(stage + 1, mb)] < t
+                    consumed.append(('c', r, c))
+                # reads and frees exactly its F's slot
+                assert slot_of.pop((stage, mb)) == slot
+                stored[r].discard(slot)
+                b_done[(stage, mb)] = t
+                if stage > 0:
+                    produced.append(('c', (stage - 1) % p, (stage - 1) // p))
+                nb += 1
+        for kind, r, c in consumed:
+            d = act_live if kind == 'a' else cot_live
+            d[(r, c)] = d.get((r, c), 0) - 1
+        for kind, r, c in produced:
+            d = act_live if kind == 'a' else cot_live
+            d[(r, c)] = d.get((r, c), 0) + 1
+            cap = s.act_depth if kind == 'a' else s.cot_depth
+            assert d[(r, c)] <= cap, (t, kind, r, c)
+    assert nf == nb == p * m * v
+    assert not slot_of  # every F was retired by its B
+
+
+def test_single_slot_realizes_megatron_bubble():
+    """The whole point: per-rank bubble in stage-units is 2*(p-1)/v — the
+    full Megatron reduction — where the 2-slot tick model plateaus at
+    ~25% (12 -> 10 -> 9 stage-units at p=4, m=16)."""
+    for p, m in ((4, 16), (8, 32)):
+        for v in (1, 2, 4):
+            s = interleaved.generate_single_slot(p, v, m)
+            su = s.bubble_slots() / p / v
+            assert su == 2 * (p - 1) / v, (p, v, su)
+            two = interleaved.generate(p, v, m)
+            assert s.bubble_slots() <= two.bubble_slots()
+
+
+def test_single_slot_rejects_invalid():
+    with pytest.raises(ValueError):
+        interleaved.generate_single_slot(4, 2, 6)  # m not multiple of p
+    with pytest.raises(ValueError):
+        interleaved.generate_single_slot(4, 0, 8)

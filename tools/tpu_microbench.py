@@ -241,10 +241,11 @@ def bench_bubble() -> None:
     """Interleaved-1F1B schedule bubble accounting (kfac_tpu.parallel.
     interleaved): idle chunk-slots per total, normalized to stage-time
     units so v configurations are comparable. Pure schedule math — the
-    cross-v comparison holds on any hardware. Under the combined-scan
-    (F,B)-pair tick model the interleaving gain is bounded (~25% at
-    p=4); the single-slot scan variant (one F OR B chunk per tick) is
-    the design that realizes the full (p-1)/v Megatron reduction."""
+    cross-v comparison holds on any hardware. Two tick models: the
+    combined-scan (F,B)-pair model caps the interleaving gain (~25% at
+    p=4); the SINGLE-SLOT tables (one F OR B chunk per tick — the model
+    InterleavedPipelinedLM executes) realize the full 2*(p-1)/v Megatron
+    reduction."""
     from kfac_tpu.parallel import interleaved
 
     for p, m in ((4, 16), (8, 32)):
@@ -255,6 +256,8 @@ def bench_bubble() -> None:
             stage_units = idle / v  # chunk time = stage time / v
             if base is None:
                 base = stage_units
+            single = interleaved.generate_single_slot(p, v, m)
+            ss_units = single.bubble_slots() / p / v
             # schedule math, not a timed measurement: no ms field
             print(json.dumps({
                 'op': f'pipeline_bubble_p{p}_v{v}_m{m}',
@@ -262,6 +265,8 @@ def bench_bubble() -> None:
                 'bubble_frac': round(idle / (2 * sched.ticks), 4),
                 'bubble_stage_units': round(stage_units, 2),
                 'vs_v1': round(stage_units / base, 3),
+                'single_slot_stage_units': round(ss_units, 2),
+                'single_slot_ring': single.ring,
             }), flush=True)
 
 
