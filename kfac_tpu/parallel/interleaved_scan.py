@@ -50,7 +50,6 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from kfac_tpu.layers import capture as capture_lib
-from kfac_tpu.ops import losses as losses_lib
 from kfac_tpu.parallel import interleaved as interleaved_lib
 from kfac_tpu.parallel import pipeline as pipeline_lib
 from kfac_tpu.parallel.pipeline import PIPE_AXIS
@@ -73,6 +72,9 @@ class InterleavedPipelinedLM(pipeline_lib.PipelinedLM):
     """
 
     virtual_chunks: int = 2
+
+    def _executes_interleaved(self) -> bool:
+        return True
 
     def _chunks_per_rank(self) -> int:
         # consulted by PipelinedLM.__post_init__ BEFORE it builds the
@@ -145,19 +147,8 @@ class InterleavedPipelinedLM(pipeline_lib.PipelinedLM):
         # this rank's tick table: (ticks, 4) — static array, varying index
         ops_r = jnp.take(jnp.asarray(sched.ops), rank, axis=1)
 
-        def head_loss(y, hp, lp, tgt):
-            yl = self.ln_f.apply({'params': lp}, y.astype(jnp.float32))
-            logits = self.head.apply({'params': hp}, yl)
-            return jnp.sum(losses_lib.vocab_parallel_nll(logits, tgt)) / (
-                total_tokens
-            )
-
-        zeros_like_vary = lambda t: jax.tree_util.tree_map(
-            lambda x: jax.lax.pcast(
-                jnp.zeros(x.shape, x.dtype), all_axes, to='varying'
-            ),
-            t,
-        )
+        head_loss = self._make_head_loss(total_tokens)
+        zeros_like_vary = self._zeros_like_vary(all_axes)
         zero_a = {
             name: jnp.zeros((v,) + h.a_factor_shape, jnp.float32)
             for name, h in registry.layers.items()

@@ -148,7 +148,7 @@ class PipelinedLM:
                 f"unknown schedule {self.schedule!r}: 'gpipe', '1f1b', or "
                 f"'interleaved'"
             )
-        if self.schedule == 'interleaved' and self._chunks_per_rank() == 1:
+        if self.schedule == 'interleaved' and not self._executes_interleaved():
             raise ValueError(
                 "the 'interleaved' schedule requires "
                 'InterleavedPipelinedLM (parallel/interleaved_scan.py)'
@@ -212,6 +212,39 @@ class PipelinedLM:
         """Model chunks per pipeline rank (1 here; the interleaved
         subclass returns ``virtual_chunks``)."""
         return 1
+
+    def _executes_interleaved(self) -> bool:
+        """Whether this class runs the single-slot interleaved scan —
+        NOT the same as ``_chunks_per_rank() > 1``: an
+        InterleavedPipelinedLM with ``virtual_chunks=1`` is valid and
+        still executes the interleaved scan."""
+        return False
+
+    def _make_head_loss(self, total_tokens: float):
+        """Summed-token-NLL/total_tokens closure shared by the combined
+        1F1B and single-slot interleaved bodies (the fused NLL keeps the
+        head vocab-parallel when the kernel is sharded over the automatic
+        model axis — ops/losses.vocab_parallel_nll)."""
+
+        def head_loss(y, hp, lp, tgt):
+            yl = self.ln_f.apply({'params': lp}, y.astype(jnp.float32))
+            logits = self.head.apply({'params': hp}, yl)
+            return jnp.sum(losses_lib.vocab_parallel_nll(logits, tgt)) / (
+                total_tokens
+            )
+
+        return head_loss
+
+    @staticmethod
+    def _zeros_like_vary(all_axes):
+        """Fresh zeros pcast varying over ``all_axes`` (scan carries and
+        cond branches must agree with the inputs' vma types)."""
+        return lambda t: jax.tree_util.tree_map(
+            lambda v: jax.lax.pcast(
+                jnp.zeros(v.shape, v.dtype), all_axes, to='varying'
+            ),
+            t,
+        )
 
     # ------------------------------------------------------------ params
 
@@ -556,30 +589,12 @@ class PipelinedLM:
         fwd_perm = [(j, (j + 1) % n) for j in range(n)]
         bwd_perm = [(j, (j - 1) % n) for j in range(n)]
 
-        def head_loss(y, hp, lp, tgt):
-            """Summed token NLL / total_tokens for one microbatch.
-
-            The fused NLL keeps the head vocab-parallel when the kernel is
-            sharded over the (automatic) model axis: the d x V matmul and
-            the softmax reductions stay 1/tp per device (see
-            ops/losses.vocab_parallel_nll).
-            """
-            yl = self.ln_f.apply({'params': lp}, y.astype(jnp.float32))
-            logits = self.head.apply({'params': hp}, yl)
-            return jnp.sum(losses_lib.vocab_parallel_nll(logits, tgt)) / (
-                total_tokens
-            )
-
+        head_loss = self._make_head_loss(total_tokens)
         zero_a = {
             name: jnp.zeros(h.a_factor_shape, jnp.float32)
             for name, h in registry.layers.items()
         }
-        zeros_like_vary = lambda t: jax.tree_util.tree_map(
-            lambda v: jax.lax.pcast(
-                jnp.zeros(v.shape, v.dtype), all_axes, to='varying'
-            ),
-            t,
-        )
+        zeros_like_vary = self._zeros_like_vary(all_axes)
 
         carry0 = dict(
             x_f=zeros_like_vary(jnp.zeros((b_m, s_len, d), self.dtype)),

@@ -173,6 +173,13 @@ def test_interleaved_field_roundtrip_and_apply_guard():
     assert plm.schedule == 'interleaved'
     plm2 = dc.replace(plm, n_microbatches=8)
     assert plm2.n_microbatches == 8 and plm2._sched.ticks > plm._sched.ticks
+    # v=1 is valid (plain 1F1B as a single-slot schedule) and must also
+    # round-trip: the parent guard keys on the class, not the chunk count
+    plm1 = InterleavedPipelinedLM(
+        mesh=mesh, vocab_size=V, d_model=16, num_heads=2, num_layers=4,
+        n_microbatches=4, max_len=8, virtual_chunks=1,
+    )
+    assert dc.replace(plm1, n_microbatches=8).virtual_chunks == 1
     with pytest.raises(NotImplementedError, match='loss_and_stats'):
         plm.apply(plm.init(jax.random.PRNGKey(0)), jnp.zeros((8, 8), jnp.int32))
 
