@@ -179,7 +179,15 @@ def build_side_buckets(
 
 
 class DistKFACState(NamedTuple):
-    """Stacked K-FAC state: bucket key -> (L, d, d) arrays."""
+    """Stacked K-FAC state: bucket key -> (L, d, d) arrays.
+
+    ``inv_damping`` records the damping the RESIDENT decompositions were
+    built with (schedules resolve per step, so it can differ from the
+    current step's damping) — consumed by
+    :meth:`DistributedKFAC.inverse_residuals` so quality monitoring
+    measures the inverse against the system it actually solved. Derived
+    state: recomputed with the decompositions, never checkpointed.
+    """
 
     step: jax.Array
     a: dict[str, jax.Array]
@@ -191,6 +199,7 @@ class DistKFACState(NamedTuple):
     dgda: dict[str, jax.Array]
     a_inv: dict[str, jax.Array]
     g_inv: dict[str, jax.Array]
+    inv_damping: jax.Array
 
 
 @dataclasses.dataclass
@@ -340,6 +349,7 @@ class DistributedKFAC:
             dgda={b.key: dec for b in self.buckets} if self._prediv else {},
             a_inv={} if eigen else adict(dec),
             g_inv={} if eigen else gdict(dec),
+            inv_damping=rep,
         )
 
     # ----------------------------------------------------------------- init
@@ -389,6 +399,10 @@ class DistributedKFAC:
                 step=jnp.asarray(0, jnp.int32),
                 a=a, g=g, qa=qa, qg=qg, da=da, dg=dg, dgda=dgda,
                 a_inv=a_inv, g_inv=g_inv,
+                inv_damping=jnp.asarray(
+                    _resolve(cfg.damping, jnp.asarray(0, jnp.int32)),
+                    jnp.float32,
+                ),
             )
 
         return jax.jit(build, out_shardings=self.state_shardings())()
@@ -604,7 +618,10 @@ class DistributedKFAC:
                     dgda[b.key] = jax.lax.with_sharding_constraint(
                         fused.astype(cfg.inv_dtype), dec
                     )
-            return state._replace(qa=qa, qg=qg, da=da, dg=dg, dgda=dgda)
+            return state._replace(
+                qa=qa, qg=qg, da=da, dg=dg, dgda=dgda,
+                inv_damping=jnp.asarray(damping, jnp.float32),
+            )
         a_inv, g_inv = {}, {}
         for sb in self.a_store:
             a_inv[sb.key] = jax.lax.with_sharding_constraint(
@@ -614,7 +631,56 @@ class DistributedKFAC:
             g_inv[sb.key] = jax.lax.with_sharding_constraint(
                 self._sharded_inv(state.g[sb.key], damping).astype(cfg.inv_dtype), dec
             )
-        return state._replace(a_inv=a_inv, g_inv=g_inv)
+        return state._replace(
+            a_inv=a_inv, g_inv=g_inv,
+            inv_damping=jnp.asarray(damping, jnp.float32),
+        )
+
+    def inverse_residuals(
+        self, state: DistKFACState
+    ) -> dict[str, dict[str, jax.Array]]:
+        """Per-slot relative identity residuals of the CURRENT damped
+        inverses: ``||I - (F + damping*I) F_inv||_F / sqrt(d)``.
+
+        Out-of-band quality monitoring for the stacked INVERSE engine:
+        the vmapped solve cannot surface ``NewtonSchulzInfo`` in-band
+        (under vmap a cond lowers to a select that pays both branches —
+        see the ``inverse_solver='auto'`` caveat), so callers sample this
+        between steps (e.g. each ``inv_update_steps``) and alert on
+        values above :data:`kfac_tpu.ops.factors.NS_FALLBACK_RESIDUAL`.
+        Identity-padded slots report ~0. Returns
+        ``{'a': {bucket_key: (L,)}, 'g': {...}}``; jit-friendly.
+        """
+        if self._eigen:
+            raise ValueError(
+                'inverse_residuals applies to the INVERSE compute method; '
+                'the EIGEN path reconstructs from eigendecompositions '
+                'whose quality is a property of eigh, not an iteration'
+            )
+        # the damping the resident inverses were BUILT with — a scheduled
+        # damping resolved at the current step would add a spurious
+        # |delta_damping| * ||F_inv|| floor to a perfect inverse
+        damping = state.inv_damping
+
+        def residuals(f, finv):
+            d = f.shape[-1]
+            eye = jnp.eye(d, dtype=jnp.float32)
+            m = f.astype(jnp.float32) + damping * eye
+            r = eye - jnp.einsum(
+                'lij,ljk->lik', m, finv.astype(jnp.float32)
+            )
+            return jnp.sqrt(jnp.sum(r * r, axis=(-2, -1)) / d)
+
+        return {
+            'a': {
+                sb.key: residuals(state.a[sb.key], state.a_inv[sb.key])
+                for sb in self.a_store
+            },
+            'g': {
+                sb.key: residuals(state.g[sb.key], state.g_inv[sb.key])
+                for sb in self.g_store
+            },
+        }
 
     # --------------------------------------------------------- precondition
 

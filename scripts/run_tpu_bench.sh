@@ -1,0 +1,36 @@
+#!/bin/bash
+# One-command TPU measurement session: run the staged bench (which
+# validates the Pallas kernels and measures the flagship both ways), then
+# the extended microbench configs, leaving everything under bench_runs/
+# and logs beside it. Run when `jax.devices()` reports a healthy TPU.
+#
+# The bench orchestrator handles a mid-session tunnel drop per stage
+# (SIGTERM-grace watchdogs, per-run persistence), so this script never
+# needs an outer kill -9 — which would wedge the tunnel.
+set -u
+cd "$(dirname "$0")/.."
+
+: "${BENCH_DEADLINE_S:=2400}"
+: "${BENCH_PROBE_BUDGET_S:=90}"
+export BENCH_DEADLINE_S BENCH_PROBE_BUDGET_S
+
+mkdir -p bench_runs
+stamp=$(date +%Y%m%d_%H%M%S)
+echo "[run_tpu_bench] bench.py (deadline ${BENCH_DEADLINE_S}s)"
+python bench.py > "bench_runs/stdout_${stamp}.json" 2> "bench_runs/stderr_${stamp}.log"
+rc=$?
+echo "[run_tpu_bench] bench rc=${rc}"
+tail -3 "bench_runs/stderr_${stamp}.log"
+
+# extended per-op configs only if the chip is still healthy (cheap probe)
+if timeout 60 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null; then
+  echo "[run_tpu_bench] extended microbench (--resnet --pipeline --head --bubble)"
+  JAX_COMPILATION_CACHE_DIR=/tmp/kfac_bench_jax_cache \
+    python tools/tpu_microbench.py --no-pallas --sizes 512 1024 2048 --iters 10 \
+    --resnet --pipeline --head --bubble \
+    > "bench_runs/micro_ext_${stamp}.jsonl" 2>> "bench_runs/stderr_${stamp}.log"
+  echo "[run_tpu_bench] microbench rc=$?"
+else
+  echo "[run_tpu_bench] chip no longer reachable; skipping extended microbench"
+fi
+echo "[run_tpu_bench] results under bench_runs/ (stamp ${stamp})"
