@@ -88,7 +88,16 @@ def main(argv=None) -> float:
     )
     p.add_argument('--pipeline-microbatches', type=int, default=4)
     p.add_argument(
-        '--pipeline-schedule', choices=['gpipe', '1f1b'], default='1f1b'
+        '--pipeline-schedule',
+        choices=['gpipe', '1f1b', 'interleaved'], default='1f1b',
+        help="'interleaved' runs the single-slot Megatron virtual-stage "
+        'schedule (--virtual-chunks model chunks per rank; microbatches '
+        'must be a multiple of the stage count)',
+    )
+    p.add_argument(
+        '--virtual-chunks', type=int, default=2,
+        help='model chunks per pipeline rank under '
+        '--pipeline-schedule=interleaved (bubble ~ 2*(p-1)/v stage-units)',
     )
     common.add_train_args(p)
     common.add_kfac_args(p)
@@ -196,7 +205,7 @@ def _pipeline_main(args) -> float:
         n_stages=args.pipeline_stages, model=args.model_shards
     )
     tokens_np, vocab = data.lm_corpus(args.data_dir, args.vocab_size)
-    plm = PipelinedLM(
+    kw = dict(
         mesh=pmesh,
         vocab_size=vocab,
         d_model=args.d_model,
@@ -205,14 +214,22 @@ def _pipeline_main(args) -> float:
         n_microbatches=args.pipeline_microbatches,
         max_len=args.seq_len,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
-        schedule=args.pipeline_schedule,
         skip_layers=tuple(args.kfac_skip_layers),
     )
+    if args.pipeline_schedule == 'interleaved':
+        from kfac_tpu.parallel import InterleavedPipelinedLM
+
+        plm = InterleavedPipelinedLM(
+            virtual_chunks=args.virtual_chunks, **kw
+        )
+    else:
+        plm = PipelinedLM(schedule=args.pipeline_schedule, **kw)
     params = plm.init(jax.random.PRNGKey(args.seed))
     print(
-        f'pipeline: {args.pipeline_stages} stages x '
+        f'pipeline: {args.pipeline_stages} ranks x '
         f'{dict(pmesh.shape)} mesh, {args.pipeline_microbatches} '
-        f'microbatches, schedule={args.pipeline_schedule}; '
+        f'microbatches, schedule={args.pipeline_schedule} '
+        f'({plm.n_stages} logical stages); '
         f'{len(plm.stage_registry)} K-FAC layers per stage'
     )
 

@@ -17,7 +17,11 @@ from kfac_tpu.enums import (
     DistributedStrategy,
 )
 from kfac_tpu.layers.capture import CapturedStats, CurvatureCapture
-from kfac_tpu.layers.registry import Registry, register_model
+from kfac_tpu.layers.registry import (
+    Registry,
+    merge_registries,
+    register_model,
+)
 from kfac_tpu.preconditioner import KFACPreconditioner, KFACState
 from kfac_tpu.training import Trainer, TrainState
 
@@ -39,6 +43,7 @@ __all__ = [
     'default_compute_method',
     'enums',
     'hyperparams',
+    'merge_registries',
     'register_model',
     'tracing',
     'warnings',

@@ -252,3 +252,21 @@ def merge_layer_grads(
     for name, value in layer_grads.items():
         out = replace(out, registry.param_paths[name], value)
     return out
+
+
+def merge_registries(*registries: Registry) -> Registry:
+    """Union of disjoint registries into one (e.g. a model's interceptor
+    registry plus per-block EP registries, so a single K-FAC engine
+    preconditions every layer). Name collisions are an error — give each
+    EP block a distinct ``name_prefix``."""
+    layers: dict[str, helpers.LayerHelper] = {}
+    paths: dict[str, tuple[str, ...]] = {}
+    for r in registries:
+        overlap = set(layers) & set(r.layers)
+        if overlap:
+            raise ValueError(
+                f'layer names collide across registries: {sorted(overlap)}'
+            )
+        layers.update(r.layers)
+        paths.update(r.param_paths)
+    return Registry(layers=layers, param_paths=paths)

@@ -7,7 +7,10 @@ from kfac_tpu.parallel import (
     pipeline,
     tensor_parallel,
 )
-from kfac_tpu.parallel.expert_parallel import EPSwitchFFN
+from kfac_tpu.parallel.expert_parallel import (
+    EPSwitchFFN,
+    combined_value_stats_and_grad,
+)
 from kfac_tpu.parallel.interleaved_scan import InterleavedPipelinedLM
 from kfac_tpu.parallel.kaisa import DistKFACState, DistributedKFAC, build_buckets
 from kfac_tpu.parallel.mesh import (
@@ -30,6 +33,7 @@ __all__ = [
     'batch_sharding',
     'build_buckets',
     'collectives',
+    'combined_value_stats_and_grad',
     'expert_parallel',
     'kaisa_mesh',
     'mesh',

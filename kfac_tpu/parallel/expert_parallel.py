@@ -346,42 +346,116 @@ class EPSwitchFFN:
         using ``ffn(params, x)`` for the MoE block (``ffn`` closes over
         the capture taps). Returns
         ``run(params, batch) -> ((loss, None), grads, CapturedStats)``.
+        Multi-block models use :func:`combined_value_stats_and_grad`.
         """
+        return combined_value_stats_and_grad(
+            lambda params, batch, ffns: loss_fn(params, batch, ffns[0]),
+            ep_ffns=(self,),
+        )
 
-        def run(params: dict[str, Any], batch: Any):
-            d_model = params[self._names()[0]]['kernel'].shape[0]
-            a_box: dict[str, jax.Array] = {}
 
-            def tapped(params, gstats, batch):
-                calls = [0]
+def combined_value_stats_and_grad(
+    loss_fn: Callable[..., jax.Array],
+    registry: Any = None,
+    ep_ffns: tuple[EPSwitchFFN, ...] = (),
+) -> Callable[..., Any]:
+    """One ``value_and_grad`` spanning interceptor capture (ordinary flax
+    layers registered in ``registry``) AND any number of EP FFN blocks.
 
+    ``loss_fn(params, batch, ffns)`` computes the loss; flax modules run
+    normally (the interceptor taps them), the i-th MoE block runs as
+    ``ffns[i](params, x)``. Each :class:`EPSwitchFFN` needs a distinct
+    ``name_prefix`` so its layer names cannot collide. Returns
+    ``run(params, batch) -> ((loss, None), grads, CapturedStats)`` with
+    the merged per-layer statistics dicts — exactly what the K-FAC
+    engines consume (merge the registries likewise for the engine).
+    """
+    prefixes = [ffn.name_prefix for ffn in ep_ffns]
+    if len(set(prefixes)) != len(prefixes):
+        raise ValueError(
+            f'EP FFN name_prefixes must be distinct, got {prefixes}'
+        )
+    cap = (
+        capture_lib.CurvatureCapture(registry)
+        if registry is not None and len(registry.layers)
+        else None
+    )
+
+    def run(params: dict[str, Any], batch: Any):
+        d_models = [
+            params[ffn._names()[0]]['kernel'].shape[0] for ffn in ep_ffns
+        ]
+        boxes: list[dict[str, jax.Array]] = [{} for _ in ep_ffns]
+
+        def tapped(params, flax_gstats, ep_gstats, batch):
+            calls = [0] * len(ep_ffns)
+
+            def make_ffn(i):
                 def ffn(p, x):
-                    # single-invocation contract: a second call would
-                    # overwrite the A stats while the G-taps kept summing
-                    # into the same dummies — silently inconsistent
-                    # curvature. One EPSwitchFFN instance per MoE block.
-                    if calls[0]:
+                    # one invocation per block per loss evaluation: a
+                    # second call would overwrite A stats while G-taps
+                    # kept summing into the same dummies
+                    if calls[i]:
                         raise ValueError(
-                            'value_stats_and_grad supports exactly one ffn '
-                            'call per loss evaluation; use a separate '
-                            'EPSwitchFFN (name_prefix=...) per MoE block'
+                            f'EP block {i} ({prefixes[i]!r}) called more '
+                            'than once per loss evaluation; use one '
+                            'EPSwitchFFN (distinct name_prefix) per block'
                         )
-                    calls[0] += 1
-                    y, a_stats = self.apply(p, x, gstats)
-                    a_box.clear()
-                    a_box.update(a_stats)
+                    calls[i] += 1
+                    y, a_stats = ep_ffns[i].apply(p, x, ep_gstats[i])
+                    boxes[i].clear()
+                    boxes[i].update(a_stats)
                     return y
 
-                loss = loss_fn(params, batch, ffn)
-                return loss, dict(a_box)
+                return ffn
 
-            (loss, a_stats), (grads, g_stats) = jax.value_and_grad(
-                tapped, argnums=(0, 1), has_aux=True
-            )(params, self.zero_gstats(d_model), batch)
-            stats = capture_lib.CapturedStats(a=a_stats, g=g_stats)
-            return (loss, None), grads, stats
+            ffns = [make_ffn(i) for i in range(len(ep_ffns))]
+            if cap is not None:
+                loss, (_, a_stats, counts) = cap.tapped(
+                    lambda p, b: loss_fn(p, b, ffns)
+                )(params, flax_gstats, batch)
+            else:
+                loss = loss_fn(params, batch, ffns)
+                a_stats, counts = {}, {}
+            # an uninvoked block would contribute all-zero G factors (the
+            # unused dummies' gradients) with NO matching A factors —
+            # silent curvature corruption; fail like the double-call case
+            missing = [
+                prefixes[i] for i in range(len(ep_ffns)) if not calls[i]
+            ]
+            if missing:
+                raise ValueError(
+                    f'EP block(s) {missing} were never called by loss_fn; '
+                    'every ffn in ep_ffns must run exactly once per loss '
+                    'evaluation'
+                )
+            return loss, (a_stats, counts, [dict(b) for b in boxes])
 
-        return run
+        flax_g0 = cap.zero_gstats() if cap is not None else {}
+        ep_g0 = [
+            ffn.zero_gstats(d) for ffn, d in zip(ep_ffns, d_models)
+        ]
+        (loss, (fa, counts, ep_a)), (grads, flax_g, ep_g) = (
+            jax.value_and_grad(tapped, argnums=(0, 1, 2), has_aux=True)(
+                params, flax_g0, ep_g0, batch
+            )
+        )
+        # interceptor stats average over repeated module calls (weight
+        # sharing), CurvatureCapture's convention; EP stats are already
+        # normalized in-body
+        a_all: dict[str, jax.Array] = {
+            n: fa[n] / counts[n].astype(fa[n].dtype) for n in fa
+        }
+        g_all: dict[str, jax.Array] = {
+            n: flax_g[n] / counts[n].astype(flax_g[n].dtype) for n in fa
+        }
+        for a_i, g_i in zip(ep_a, ep_g):
+            a_all.update(a_i)
+            g_all.update(g_i)
+        stats = capture_lib.CapturedStats(a=a_all, g=g_all)
+        return (loss, None), grads, stats
+
+    return run
 
 
 def _router_gtap(reduce_axes: tuple[str, ...]):

@@ -34,3 +34,6 @@ else
   echo "[run_tpu_bench] chip no longer reachable; skipping extended microbench"
 fi
 echo "[run_tpu_bench] results under bench_runs/ (stamp ${stamp})"
+# keep-going behavior above is intentional (partials are valuable), but
+# callers must still see a failed bench as a failed session
+exit "$rc"

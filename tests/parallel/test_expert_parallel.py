@@ -154,3 +154,154 @@ def test_ep_kfac_step_trains():
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.7, losses
     assert all(b <= a * 1.02 for a, b in zip(losses, losses[1:])), losses
+
+
+def test_combined_capture_two_ep_blocks_plus_flax_layer():
+    """combined_value_stats_and_grad spans interceptor capture (a dense
+    projection) and TWO EP blocks in one value_and_grad; loss, grads, and
+    every A/G factor match the all-flax oracle (Proj + two MoEMLPs with
+    routed registry capture) on shared parameters."""
+    from kfac_tpu.layers.registry import merge_registries
+    from kfac_tpu.parallel.expert_parallel import (
+        combined_value_stats_and_grad,
+    )
+
+    class Oracle(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(D, name='proj')(x)
+            x = MoEMLP(num_experts=E, mlp_ratio=2, name='moe0')(x)
+            return MoEMLP(num_experts=E, mlp_ratio=2, name='moe1')(x)
+
+    mesh = train_mesh(expert=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, D))
+    target = jnp.tanh(jnp.roll(x, 1, -1))
+    oracle = Oracle()
+    oparams = oracle.init(jax.random.PRNGKey(1), x)['params']
+    oreg = kfac_tpu.register_model(
+        oracle, x, routed_layers=[r'.*expert\d+_(up|down)']
+    )
+
+    def oracle_loss(p, batch):
+        xb, tb = batch
+        return jnp.mean((oracle.apply({'params': p}, xb) - tb) ** 2)
+
+    run_ref = kfac_tpu.CurvatureCapture(oreg).value_stats_and_grad(
+        oracle_loss
+    )
+    (l_ref, _), g_ref, s_ref = run_ref(oparams, (x, target))
+
+    # --- EP path: same params, flattened EP entries + the flax proj
+    class Proj(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(D, name='proj')(x)
+
+    proj = Proj()
+    eparams = {'proj': oparams['proj']}
+    for blk in ('moe0', 'moe1'):
+        for k, v in oparams[blk].items():
+            eparams[f'{blk}/{k}'] = v
+    ffn0 = EPSwitchFFN(
+        mesh=mesh, num_experts=E, mlp_ratio=2, capacity_factor=float(E),
+        name_prefix='moe0/',
+    )
+    ffn1 = EPSwitchFFN(
+        mesh=mesh, num_experts=E, mlp_ratio=2, capacity_factor=float(E),
+        name_prefix='moe1/',
+    )
+    preg = kfac_tpu.register_model(proj, x)
+    merged = merge_registries(preg, ffn0.registry(D), ffn1.registry(D))
+    assert set(merged.layers) == set(oreg.layers)
+
+    def ep_loss(p, batch, ffns):
+        xb, tb = batch
+        h = proj.apply({'params': {'proj': p['proj']}}, xb)
+        h = ffns[0](p, h)
+        return jnp.mean((ffns[1](p, h) - tb) ** 2)
+
+    xs = jax.device_put(x, token_sharding(mesh))
+    ts = jax.device_put(target, token_sharding(mesh))
+    run_ep = combined_value_stats_and_grad(
+        ep_loss, registry=preg, ep_ffns=(ffn0, ffn1)
+    )
+    (l_ep, _), g_ep, s_ep = run_ep(eparams, (xs, ts))
+
+    np.testing.assert_allclose(float(l_ep), float(l_ref), rtol=1e-5)
+    # grads: flax-nested oracle vs flat EP keys
+    def oracle_leaf(name, leaf):
+        if '/' in name:
+            blk, sub = name.split('/')
+            return g_ref[blk][sub][leaf]
+        return g_ref[name][leaf]
+
+    for name in eparams:
+        for leaf in eparams[name]:
+            np.testing.assert_allclose(
+                np.asarray(g_ep[name][leaf]),
+                np.asarray(oracle_leaf(name, leaf)),
+                rtol=5e-4, atol=2e-6, err_msg=f'grad {name}/{leaf}',
+            )
+    assert set(s_ep.a) == set(s_ref.a)
+    for name in s_ref.a:
+        np.testing.assert_allclose(
+            np.asarray(s_ep.a[name]), np.asarray(s_ref.a[name]),
+            rtol=5e-4, atol=2e-6, err_msg=f'A {name}',
+        )
+        np.testing.assert_allclose(
+            np.asarray(s_ep.g[name]), np.asarray(s_ref.g[name]),
+            rtol=5e-4, atol=2e-6, err_msg=f'G {name}',
+        )
+
+
+def test_combined_capture_rejects_duplicate_prefixes_and_double_call():
+    from kfac_tpu.parallel.expert_parallel import (
+        combined_value_stats_and_grad,
+    )
+
+    mesh = train_mesh(expert=2)
+    ffn = EPSwitchFFN(mesh=mesh, num_experts=E, mlp_ratio=2)
+    with pytest.raises(ValueError, match='distinct'):
+        combined_value_stats_and_grad(
+            lambda p, b, f: 0.0, ep_ffns=(ffn, ffn)
+        )
+
+    params = ffn.init(jax.random.PRNGKey(0), D)
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (B, S, D)),
+        token_sharding(mesh),
+    )
+
+    def loss_double_call(p, batch, ffns):
+        y = ffns[0](p, batch)
+        return jnp.mean(ffns[0](p, y) ** 2)  # second call: must raise
+
+    run = combined_value_stats_and_grad(loss_double_call, ep_ffns=(ffn,))
+    with pytest.raises(ValueError, match='more than once'):
+        run(params, x)
+
+
+def test_combined_capture_rejects_uninvoked_block():
+    """A block that loss_fn never calls would contribute all-zero G
+    factors with no A factors — the runner raises instead."""
+    from kfac_tpu.parallel.expert_parallel import (
+        combined_value_stats_and_grad,
+    )
+
+    mesh = train_mesh(expert=2)
+    ffn0 = EPSwitchFFN(mesh=mesh, num_experts=E, mlp_ratio=2,
+                       name_prefix='a/')
+    ffn1 = EPSwitchFFN(mesh=mesh, num_experts=E, mlp_ratio=2,
+                       name_prefix='b/')
+    params = {**ffn0.init(jax.random.PRNGKey(0), D),
+              **ffn1.init(jax.random.PRNGKey(1), D)}
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(2), (B, S, D)),
+        token_sharding(mesh),
+    )
+    run = combined_value_stats_and_grad(
+        lambda p, b, ffns: jnp.mean(ffns[0](p, b) ** 2),  # ffn1 unused
+        ep_ffns=(ffn0, ffn1),
+    )
+    with pytest.raises(ValueError, match='never called'):
+        run(params, x)
