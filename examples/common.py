@@ -240,6 +240,42 @@ def build_kfac(args, registry, mesh=None, lr=None, verbose_dump=True):
     return cfg
 
 
+def log_inverse_residuals(args, kfac_engine, kfac_state) -> None:
+    """Under ``--kfac-verbose``, print the worst per-slot damped-inverse
+    residual of a DistributedKFAC INVERSE engine (out-of-band
+    Newton-Schulz quality monitoring — the stacked vmapped solve cannot
+    surface convergence info in-band). No-op for other engines/methods."""
+    if not getattr(args, 'kfac_verbose', False):
+        return
+    if kfac_engine is None or not hasattr(kfac_engine, 'inverse_residuals'):
+        return
+    import jax
+    import jax.numpy as jnp
+
+    # the reduction runs under jit to ONE replicated scalar: the state
+    # arrays are sharded (non-addressable on multi-host pods), so eager
+    # ops / np.asarray on them would fail exactly where this monitoring
+    # matters most. jnp.max propagates NaN — a diverged solve reports NaN.
+    def _worst(state):
+        res = kfac_engine.inverse_residuals(state)
+        return jnp.max(jnp.stack([
+            jnp.max(r) for side in res.values() for r in side.values()
+        ]))
+
+    try:
+        worst = float(jax.jit(_worst)(kfac_state))
+    except ValueError:  # EIGEN method: the query is meaningless
+        return
+    from kfac_tpu.ops.factors import NS_FALLBACK_RESIDUAL
+
+    # NaN must flag as bad (all NaN comparisons are False, so test the
+    # HEALTHY direction — the library's own convention, ops/factors.py)
+    flag = '' if worst <= NS_FALLBACK_RESIDUAL else (
+        '  [ABOVE FALLBACK THRESHOLD]'
+    )
+    print(f'  kfac inverse residual (worst slot): {worst:.2e}{flag}')
+
+
 def make_epoch_batches(
     args,
     x_train,

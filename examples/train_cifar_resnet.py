@@ -149,6 +149,7 @@ def main(argv=None) -> float:
             {'train_loss': train_loss.avg, 'test_acc': test_acc,
              'elapsed_s': timer.elapsed()},
         )
+        common.log_inverse_residuals(args, trainer.kfac, state.kfac_state)
         if args.checkpoint_dir:
             common.save_checkpoint(
                 args.checkpoint_dir, state, epoch, kfac_engine=trainer.kfac
