@@ -1,13 +1,13 @@
-"""Worker process for the two-process multihost test.
+"""Worker process for the multi-process multihost test.
 
 Launched by tests/parallel/test_multihost.py with KFAC_TPU_COORDINATOR /
-KFAC_TPU_NUM_PROCESSES / KFAC_TPU_PROCESS_ID set (the same rendezvous
-env-var surface scripts/run_pod.sh exports per node). Each process owns 2
-virtual CPU devices; ``multihost.initialize`` brings up the JAX distributed
-runtime, so the 4-device world spans two OS processes — the analogue of the
-reference's forked gloo process groups (testing/distributed.py:24-141),
-exercising the coordination-service + cross-process-collective paths the
-in-process 8-device mesh cannot.
+KFAC_TPU_NUM_PROCESSES (2 or 4) / KFAC_TPU_PROCESS_ID set (the same
+rendezvous env-var surface scripts/run_pod.sh exports per node). Each
+process owns 2 virtual CPU devices; ``multihost.initialize`` brings up
+the JAX distributed runtime, so a 2N-device world spans N OS processes —
+the analogue of the reference's forked gloo process groups
+(testing/distributed.py:24-141), exercising the coordination-service +
+cross-process-collective paths the in-process 8-device mesh cannot.
 
 Prints one JSON line: {process, n_processes, n_devices, loss, checksum}.
 """
@@ -46,8 +46,9 @@ def global_put(arr, sharding):
 
 
 def main() -> None:
-    assert jax.process_count() == 2, jax.process_count()
-    assert len(jax.devices()) == 4, jax.devices()
+    expected = int(os.environ['KFAC_TPU_NUM_PROCESSES'])
+    assert jax.process_count() == expected, jax.process_count()
+    assert len(jax.devices()) == 2 * expected, jax.devices()
 
     mesh = multihost.hybrid_kaisa_mesh(0.5)
     m = models.TinyModel(hidden=8, out=4)
