@@ -47,6 +47,12 @@ def _launch_workers(n: int, port: int):
         env['KFAC_TPU_COORDINATOR'] = f'127.0.0.1:{port}'
         env['KFAC_TPU_NUM_PROCESSES'] = str(n)
         env['KFAC_TPU_PROCESS_ID'] = str(pid)
+        # share the suite's persistent compile cache: n concurrent COLD
+        # compiles contending for this container's single core could push
+        # a worker past the communicate timeout
+        env.setdefault(
+            'JAX_COMPILATION_CACHE_DIR', os.path.join(REPO, '.jax_cache')
+        )
         procs.append(
             subprocess.Popen(
                 [sys.executable, WORKER],
@@ -81,9 +87,19 @@ def test_multi_process_step_matches_single_process(n_procs):
         try:
             out, err = p.communicate(timeout=600)
         except subprocess.TimeoutExpired:
-            for q in procs:
+            # kill the whole rendezvous, then collect every worker's
+            # stderr tail — a hang with no diagnostics is undebuggable
+            tails = []
+            for qi, q in enumerate(procs):
                 q.kill()
-            raise
+                try:
+                    _, qerr = q.communicate(timeout=30)
+                except Exception:  # noqa: BLE001
+                    qerr = '<unreadable>'
+                tails.append(f'--- worker {qi} stderr ---\n{qerr[-1500:]}')
+            raise AssertionError(
+                'multihost rendezvous timed out:\n' + '\n'.join(tails)
+            ) from None
         assert p.returncode == 0, f'worker failed:\n{err[-3000:]}'
         line = [l for l in out.splitlines() if l.startswith('{')][-1]
         results.append(json.loads(line))
