@@ -83,26 +83,37 @@ def test_multi_process_step_matches_single_process(n_procs):
     port = _free_port()
     procs = _launch_workers(n_procs, port)
     results = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            # kill the whole rendezvous, then collect every worker's
-            # stderr tail — a hang with no diagnostics is undebuggable
-            tails = []
-            for qi, q in enumerate(procs):
+    try:
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                # collect every worker's stderr tail — a hang with no
+                # diagnostics is undebuggable (the finally kills them)
+                tails = []
+                for qi, q in enumerate(procs):
+                    q.kill()
+                    try:
+                        _, qerr = q.communicate(timeout=30)
+                    except Exception:  # noqa: BLE001
+                        qerr = '<unreadable>'
+                    tails.append(
+                        f'--- worker {qi} stderr ---\n{qerr[-1500:]}'
+                    )
+                raise AssertionError(
+                    'multihost rendezvous timed out:\n' + '\n'.join(tails)
+                ) from None
+            assert p.returncode == 0, f'worker failed:\n{err[-3000:]}'
+            line = [l for l in out.splitlines() if l.startswith('{')][-1]
+            results.append(json.loads(line))
+    finally:
+        # ANY exit (a failed worker's assert included) must not orphan the
+        # rest of the rendezvous — blocked workers would spin on this
+        # container's single core for their full timeout
+        for q in procs:
+            if q.poll() is None:
                 q.kill()
-                try:
-                    _, qerr = q.communicate(timeout=30)
-                except Exception:  # noqa: BLE001
-                    qerr = '<unreadable>'
-                tails.append(f'--- worker {qi} stderr ---\n{qerr[-1500:]}')
-            raise AssertionError(
-                'multihost rendezvous timed out:\n' + '\n'.join(tails)
-            ) from None
-        assert p.returncode == 0, f'worker failed:\n{err[-3000:]}'
-        line = [l for l in out.splitlines() if l.startswith('{')][-1]
-        results.append(json.loads(line))
+                q.wait()
 
     # every process saw the full world and agrees bit-for-bit on the
     # replicated outputs
