@@ -7,6 +7,7 @@ XLA collectives are used directly where needed.
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kfac_tpu.parallel import collectives
 
@@ -94,3 +95,38 @@ def test_concat_flat_chunked_sizes_at_promoted_dtype():
     assert [c[0].size for c in chunks] == [25, 25, 25]
     back = collectives.split_flat_chunked(chunks)
     assert [b.dtype for b in back] == [jnp.bfloat16, jnp.float32, jnp.bfloat16]
+
+
+# --------------------------------------------------------------- property
+_hyp = pytest.importorskip('hypothesis')
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+@given(
+    sizes=st.lists(st.integers(1, 40), min_size=0, max_size=12),
+    dtypes=st.lists(st.sampled_from(['f32', 'bf16']), min_size=12,
+                    max_size=12),
+    cap=st.integers(16, 400),
+)
+@settings(max_examples=60, deadline=None)
+def test_chunked_packing_properties(sizes, dtypes, cap):
+    """For ANY tensor list and byte cap: roundtrip preserves values,
+    dtypes, and order; every multi-tensor chunk respects the cap at
+    the PROMOTED dtype (single oversized tensors ride alone)."""
+    dt = {'f32': jnp.float32, 'bf16': jnp.bfloat16}
+    tensors = [
+        jnp.arange(n, dtype=jnp.float32).astype(dt[d])
+        for n, d in zip(sizes, dtypes)
+    ]
+    chunks = collectives.concat_flat_chunked(tensors, max_bytes=cap)
+    back = collectives.split_flat_chunked(chunks)
+    assert len(back) == len(tensors)
+    for orig, rec in zip(tensors, back):
+        assert rec.dtype == orig.dtype
+        np.testing.assert_array_equal(
+            np.asarray(orig, np.float32), np.asarray(rec, np.float32)
+        )
+    for flat, specs in chunks:
+        if len(specs) > 1:
+            assert flat.size * flat.dtype.itemsize <= cap, (
+                flat.size, flat.dtype, cap
+            )

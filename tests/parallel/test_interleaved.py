@@ -170,3 +170,53 @@ def test_single_slot_rejects_invalid():
         interleaved.generate_single_slot(4, 2, 6)  # m not multiple of p
     with pytest.raises(ValueError):
         interleaved.generate_single_slot(4, 0, 8)
+
+
+# --------------------------------------------------------------- property
+# hypothesis sweep: the schedule invariants must hold for EVERY valid
+# (p, v, m), not just the hand-picked configs above — the generator is
+# the single source of truth for the executing scan's indexing
+_hyp = pytest.importorskip('hypothesis')
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+@given(
+    p=st.integers(1, 8),
+    v=st.integers(1, 4),
+    mult=st.integers(1, 4),
+)
+@settings(max_examples=60, deadline=None)
+def test_single_slot_schedule_properties(p, v, mult):
+    m = p * mult
+    s = interleaved.generate_single_slot(p, v, m)
+    last = p * v - 1
+    f_done, b_done, slot_of = {}, {}, {}
+    stored = [set() for _ in range(p)]
+    nf = nb = 0
+    for t in range(s.ticks):
+        for r in range(p):
+            kind, c, mb, slot = (int(x) for x in s.ops[t, r])
+            if kind < 0:
+                continue
+            stage = c * p + r
+            assert 0 <= c < v and 0 <= mb < m
+            if kind == 0:
+                if stage > 0:
+                    assert f_done[(stage - 1, mb)] < t
+                assert 0 <= slot < s.ring
+                assert slot not in stored[r]
+                stored[r].add(slot)
+                slot_of[(stage, mb)] = slot
+                f_done[(stage, mb)] = t
+                nf += 1
+            else:
+                assert f_done[(stage, mb)] < t
+                if stage < last:
+                    assert b_done[(stage + 1, mb)] < t
+                assert slot_of.pop((stage, mb)) == slot
+                stored[r].discard(slot)
+                b_done[(stage, mb)] = t
+                nb += 1
+    assert nf == nb == p * m * v
+    assert not slot_of
+    # the Megatron bound: per-rank bubble in stage units
+    assert s.bubble_slots() / p / v == 2 * (p - 1) / v
