@@ -146,7 +146,8 @@ def save(
     state: Any,
     extra: dict[str, Any] | None = None,
     engine: Any | None = None,
-) -> None:
+    wait: bool = True,
+) -> Any:
     """Write the durable K-FAC state (plus optional extra trees, e.g. model
     params / optax state) to ``path``.
 
@@ -155,6 +156,16 @@ def save(
     mismatch up front and to MIGRATE the factors into a differently-laid-out
     engine (other ``bucket_granularity``/``colocate_factors``, dense vs
     distributed) instead of failing on an orbax shape error.
+
+    ``wait=False`` returns immediately after orbax snapshots the arrays
+    and finishes the write on background threads — training continues
+    while the checkpoint streams out (the pod-scale pattern; the
+    reference's torch.save always blocks). Returns a handle: call its
+    ``.wait_until_finished()`` before relying on the files, and before
+    starting another save to the same path. The manifest sidecar is
+    written only once the checkpoint is DURABLE (at wait time), so a
+    manifest's presence always implies a committed checkpoint — a crash
+    mid-async-save leaves neither.
     """
     if not _HAS_ORBAX:
         raise RuntimeError('orbax-checkpoint is not available')
@@ -163,8 +174,10 @@ def save(
         payload.update(extra)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, payload)
-    ckptr.wait_until_finished()
-    if jax.process_index() == 0:
+
+    def _finalize_manifest() -> None:
+        if jax.process_index() != 0:
+            return
         mpath = _manifest_path(path)
         if engine is not None:
             if mpath is None:
@@ -173,7 +186,7 @@ def save(
                     f'manifest sidecar is plain-file IO and is skipped — '
                     f'cross-layout factor migration will be unavailable '
                     f'for this checkpoint',
-                    stacklevel=2,
+                    stacklevel=3,
                 )
             else:
                 with open(mpath, 'w') as f:
@@ -182,6 +195,29 @@ def save(
             # a stale sidecar from an earlier save at this path would make
             # restore slice the NEW payload with the OLD layout
             os.remove(mpath)
+
+    if wait:
+        ckptr.wait_until_finished()
+        _finalize_manifest()
+        return ckptr
+    return _AsyncSaveHandle(ckptr, _finalize_manifest)
+
+
+class _AsyncSaveHandle:
+    """Returned by ``save(..., wait=False)``: finishing the write also
+    finalizes the manifest sidecar, preserving the invariant that a
+    manifest on disk implies a durable checkpoint."""
+
+    def __init__(self, ckptr, finalize):
+        self._ckptr = ckptr
+        self._finalize = finalize
+        self._done = False
+
+    def wait_until_finished(self) -> None:
+        self._ckptr.wait_until_finished()
+        if not self._done:
+            self._done = True
+            self._finalize()
 
 
 def restore(
