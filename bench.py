@@ -616,6 +616,34 @@ def _orchestrate(result: dict) -> None:
             *flags,
         ]
 
+    def acc_stage(env: dict[str, str]) -> None:
+        """Steps-to-target vs SGD on digits (the metric BASELINE.json
+        names, in the driver-recorded line itself; full curves live in
+        BENCH_ACC.md). Skipped when the remaining budget is tight."""
+        budget = min(300.0, remaining() - 30.0)
+        if budget < 60.0:
+            stages['acc'] = {'status': 'skipped_no_budget'}
+            return
+        out = os.path.join(run_dir, 'acc.jsonl')
+        status = _run_stage(
+            'acc',
+            [
+                sys.executable,
+                os.path.join(here, 'tools', 'bench_accuracy.py'),
+                '--tasks', 'digits_mlp',
+                '--out', os.path.join(run_dir, 'acc.md'),
+            ],
+            env, budget, stdout_path=out,
+        )
+        rows = [r for r in _read_jsonl(out) if 'step_ratio' in r]
+        entry: dict = {'status': status}
+        if rows:
+            entry.update(rows[-1])
+            result['acc_task'] = rows[-1].get('task')
+            result['acc_step_ratio'] = rows[-1].get('step_ratio')
+            result['acc_time_ratio'] = rows[-1].get('time_ratio')
+        stages['acc'] = entry
+
     if not on_tpu:
         # CPU smoke: one tiny stage, pinned to host (PALLAS_AXON_POOL_IPS
         # scrub included — env var alone does not stop the sitecustomize
@@ -624,7 +652,7 @@ def _orchestrate(result: dict) -> None:
         env = {'JAX_PLATFORMS': 'cpu', 'PALLAS_AXON_POOL_IPS': '', **cache_env}
         status = _run_stage(
             'lm_tiny', lm_argv('tiny', out), env,
-            max(120.0, min(700.0, remaining())),
+            max(120.0, min(700.0, remaining() - 120.0)),
         )
         stage = _read_json(out)
         stages['lm_tiny'] = {'status': status, **{
@@ -633,6 +661,8 @@ def _orchestrate(result: dict) -> None:
         for k in _HEADLINE_KEYS:
             if k in stage:
                 result[k] = stage[k]
+        _persist(result)
+        acc_stage(env)
         _persist(result, partial=not stage.get('ok', False))
         return
 
@@ -704,6 +734,7 @@ def _orchestrate(result: dict) -> None:
     if 'value' in pallas:
         result['pallas_tokens_per_sec'] = pallas['value']
         result['pallas_mfu'] = pallas.get('mfu')
+    acc_stage({**cache_env})
     done = stages.get(result.get('headline_stage', ''), {}).get('status')
     _persist(result, partial=done != 'ok')
 

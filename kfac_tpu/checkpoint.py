@@ -174,6 +174,16 @@ def save(
         payload.update(extra)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, payload)
+    # remove any STALE sidecar from an earlier save at this path
+    # immediately (before the async return): whatever happens next — crash
+    # pre-commit (no checkpoint, no manifest) or crash between orbax's
+    # commit and the caller's wait (new checkpoint, no manifest: restore
+    # runs manifest-less) — a manifest on disk can only describe THIS save
+    mpath0 = _manifest_path(path)
+    if jax.process_index() == 0 and mpath0 is not None and (
+        os.path.exists(mpath0)
+    ):
+        os.remove(mpath0)
 
     def _finalize_manifest() -> None:
         if jax.process_index() != 0:
@@ -191,10 +201,6 @@ def save(
             else:
                 with open(mpath, 'w') as f:
                     json.dump(layout_manifest(engine), f, indent=1)
-        elif mpath is not None and os.path.exists(mpath):
-            # a stale sidecar from an earlier save at this path would make
-            # restore slice the NEW payload with the OLD layout
-            os.remove(mpath)
 
     if wait:
         ckptr.wait_until_finished()

@@ -576,8 +576,9 @@ def test_checkpoint_async_save_roundtrip(tmp_path):
     path = str(tmp_path / 'async_ck')
     handle = checkpoint.save(path, state, engine=kfac, wait=False)
     assert hasattr(handle, 'wait_until_finished')
+    # durable-manifest invariant: no sidecar until the wait commits it
+    assert not os.path.exists(checkpoint._manifest_path(path))
     handle.wait_until_finished()
-    # durable-manifest invariant: the sidecar exists only after the wait
     assert os.path.exists(checkpoint._manifest_path(path))
     restored, _ = checkpoint.restore(path, kfac)
     assert int(restored.step) == int(state.step)
