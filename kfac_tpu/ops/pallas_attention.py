@@ -34,6 +34,9 @@ NEG_INF = -1e30
 
 BLOCK_Q = 128
 BLOCK_K = 128
+# lane width of the m/l output tiles (TPU vector lane count); see
+# _flash_kernel's broadcast stores
+_LANE = 128
 
 
 def _flash_kernel(
@@ -42,8 +45,8 @@ def _flash_kernel(
     k_ref,         # (1, S_k, D) VMEM
     v_ref,         # (1, S_k, D) VMEM
     acc_ref,       # (1, BLOCK_Q, D) out
-    m_ref,         # (1, BLOCK_Q) out
-    l_ref,         # (1, BLOCK_Q) out
+    m_ref,         # (1, BLOCK_Q, _LANE) out (value broadcast across lanes)
+    l_ref,         # (1, BLOCK_Q, _LANE) out (value broadcast across lanes)
     *,
     causal: bool,
     block_k: int,
@@ -102,8 +105,12 @@ def _flash_kernel(
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
     acc_ref[0] = acc
-    m_ref[0] = m
-    l_ref[0] = l
+    # m/l are per-row scalars, but TPU output tiles need a lane dimension
+    # that is 128-divisible (Mosaic rejects (1, block_q) blocks on real
+    # hardware — caught on-chip, invisible in interpret mode). Broadcast
+    # across a trailing _LANE-wide dim; the wrapper slices lane 0.
+    m_ref[0] = jnp.broadcast_to(m[:, None], (block_q, _LANE))
+    l_ref[0] = jnp.broadcast_to(l[:, None], (block_q, _LANE))
 
 
 def attend_partials_einsum(q, k, v, q_offset, k_offset, causal):
@@ -146,7 +153,9 @@ def _flash_partials(q, k, v, offs, causal, block_q, block_k, interpret):
         kern, offs, q, k, v, b, h, s_q, s_k, d, block_q, n_q, interpret
     )
     acc = acc.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
-    return acc, m.reshape(b, h, s_q), l.reshape(b, h, s_q)
+    # m/l carry a broadcast _LANE trailing dim (TPU tiling); lane 0 is the
+    # value
+    return acc, m[..., 0].reshape(b, h, s_q), l[..., 0].reshape(b, h, s_q)
 
 
 def _flash_fwd(q, k, v, offs, causal, block_q, block_k, interpret):
@@ -233,8 +242,8 @@ def _call(kern, offs, q, k, v, b, h, s_q, s_k, d, block_q, n_q, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j, offs: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j, offs: (i, j)),
+            pl.BlockSpec((1, block_q, _LANE), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda i, j, offs: (i, j, 0)),
         ],
     )
     # inside a vma-checked shard_map the outputs vary over the same mesh
@@ -250,8 +259,8 @@ def _call(kern, offs, q, k, v, b, h, s_q, s_k, d, block_q, n_q, interpret):
         grid_spec=grid_spec,
         out_shape=[
             struct((b * h, s_q, d)),
-            struct((b * h, s_q)),
-            struct((b * h, s_q)),
+            struct((b * h, s_q, _LANE)),
+            struct((b * h, s_q, _LANE)),
         ],
         interpret=interpret,
     )(offs, bh(q), bh(k), bh(v))

@@ -20,28 +20,92 @@ import jax
 import jax.numpy as jnp
 
 
-def timeit(fn, *args, iters=20, warmup=1):
-    """Time fn with an INPUT-VARYING first argument each iteration.
+def _scale(tree, c):
+    """Multiply every floating leaf of a pytree by c (ints pass through:
+    token ids must stay valid)."""
+    return jax.tree_util.tree_map(
+        lambda a: a * jnp.asarray(c, a.dtype)
+        if jnp.issubdtype(jnp.result_type(a), jnp.floating) else a,
+        tree,
+    )
 
-    The axon pool backend memoizes repeated identical computations
-    (measured: an 8-deep 4096^3 matmul chain 'ran' in 0.04 ms — 30x above
-    physical peak), so same-input timing loops report cache hits. Adding
-    an iteration-dependent epsilon to the first argument forces real
-    execution while perturbing the math negligibly.
+
+def _chain(tree, out):
+    """Add a zero derived from the previous output to every floating leaf,
+    creating a cross-iteration data dependency. The zero sums one element
+    of EVERY floating output leaf so the whole previous program — not just
+    its cheapest output — must finish before the next dispatch."""
+    leaves = [x for x in jax.tree_util.tree_leaves(out)
+              if jnp.issubdtype(jnp.result_type(x), jnp.floating)]
+    if not leaves:
+        return tree
+    z = sum((jnp.ravel(x)[0] * 0.0).astype(jnp.float32) for x in leaves)
+    # inject into ONE input leaf only: an executable cannot launch until
+    # all input buffers are ready, so one dependency serializes the chain;
+    # per-leaf adds would put O(n_leaves) extra dispatches in the timed
+    # region for pytree inputs
+    done = False
+
+    def add_once(a):
+        nonlocal done
+        if done or not jnp.issubdtype(jnp.result_type(a), jnp.floating):
+            return a
+        done = True
+        return a + z.astype(a.dtype)
+
+    return jax.tree_util.tree_map(add_once, tree)
+
+
+def timeit(fn, *args, iters=20, warmup=1):
+    """Time fn with an INPUT-VARYING, ITERATION-CHAINED first argument.
+
+    Two axon-pool hazards, both measured on the real tunnel:
+    - the backend memoizes repeated identical computations (an 8-deep
+      4096^3 matmul chain 'ran' in 0.04 ms — 30x above physical peak), so
+      same-input loops report cache hits. A 1% iteration-dependent scale
+      forces real execution (additive 1e-6 would round away in bf16).
+    - INDEPENDENT dispatches overlap (or fan out across the pool), so
+      block_until_ready(last) times only the final call: the perturbed
+      loop still reported 8.4 PFLOP/s on one v5e chip (~20x peak).
+      Feeding a zero derived from iteration i's output into iteration
+      i+1's input serializes the chain without changing the math.
     """
     first, rest = args[0], args[1:]
     out = None
-    # 1% scale survives bf16 rounding (additive 1e-6 would round away)
     for i in range(warmup):
-        out = fn(first * (1.0 + 0.01 * (i + 1)), *rest)
+        out = fn(_scale(first, 1.0 + 0.01 * (i + 1)), *rest)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for i in range(iters):
-        # step must exceed bf16's spacing at 1.0 (2^-7) or adjacent
-        # iterations round to identical inputs and re-enable the cache
-        out = fn(first * (1.0 + 0.01 * (i + 1)), *rest)
+        # scales offset past the warmup range: reusing warmup's scale for
+        # timed iteration 0 (plus _chain's exact 0.0) would hand the
+        # memoizer a bitwise-identical input and a free cache hit
+        c = 1.0 + 0.01 * (warmup + i + 1)
+        out = fn(_chain(_scale(first, c), out), *rest)
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / iters
+
+
+def measured(name, thunk, iters, post=None):
+    """announce + time + report with per-op isolation: one unsupported op
+    (round 5 on-chip: axon has no host callbacks, so eigh_host raised and
+    killed the whole run) must cost one line, not the session.
+
+    ``post``: optional callable receiving the measured seconds, returning
+    extra report fields computed only on success (oracle checks, derived
+    ratios). Errors report ``ms: None`` — NOT NaN, which json.dump would
+    emit as a bare non-standard token that breaks strict consumers of the
+    persisted bench partials."""
+    announce(name)
+    try:
+        t = thunk(iters)
+        report(name, t, **(post(t) if post else {}))
+        return t
+    except Exception as exc:  # noqa: BLE001
+        print(json.dumps({'op': name, 'ms': None,
+                          'error': f'{type(exc).__name__}: {exc}'}),
+              flush=True)
+        return None
 
 
 def announce(name):
@@ -342,23 +406,23 @@ def main():
     t = timeit(dense_att, *qkv, iters=args.iters)
     report(f'attn_einsum_s{s}', t)
     if on_tpu and run_pallas:
-        try:
-            flash = jax.jit(
-                lambda q, k, v: att._finish(
-                    pa.flash_attention_partials(q, k, v, causal=True)
-                )
+        flash = jax.jit(
+            lambda q, k, v: att._finish(
+                pa.flash_attention_partials(q, k, v, causal=True)
             )
-            announce(f'attn_flash_s{s}')
-            t2 = timeit(flash, *qkv, iters=args.iters)
+        )
+
+        def flash_check(t2, _t_einsum=t):
             err = float(jnp.abs(
                 flash(*qkv).astype(jnp.float32)
                 - dense_att(*qkv).astype(jnp.float32)
             ).max())
-            report(f'attn_flash_s{s}', t2, max_err=round(err, 5),
-                   speedup=round(t / t2, 2))
-        except Exception as exc:  # noqa: BLE001
-            report(f'attn_flash_s{s}', float('nan'),
-                   error=f'{type(exc).__name__}: {exc}')
+            return {'max_err': round(err, 5),
+                    'speedup': round(_t_einsum / t2, 2)}
+
+        measured(f'attn_flash_s{s}',
+                 lambda n: timeit(flash, *qkv, iters=n), args.iters,
+                 post=flash_check)
 
     if not args.skip_factor_ops:
         for d in args.sizes:
@@ -367,23 +431,23 @@ def main():
             cov = (m.T @ m) / args.rows  # SPD test matrix
 
             if xla_ops:
+                qiters = max(3, args.iters // 4)
                 f = jax.jit(lambda c: jnp.linalg.eigh(c))
-                announce(f'eigh_{d}')
-                t = timeit(f, cov, iters=max(3, args.iters // 4))
-                report(f'eigh_{d}', t)
+                measured(f'eigh_{d}', lambda n: timeit(f, cov, iters=n),
+                         qiters)
 
                 # host-offloaded eigh (pure_callback -> LAPACK): the EIGEN
                 # method's TPU escape hatch — measures the d^2 transfer +
                 # host syevd against the device eigh above and
-                # Newton-Schulz below
+                # Newton-Schulz below. (Known-unsupported under axon_pjrt:
+                # no host send/recv callbacks — reports the error line.)
                 from kfac_tpu.ops import factors as factors_lib
 
                 fh = jax.jit(
                     lambda c: factors_lib.batched_eigh(c, impl='host')
                 )
-                announce(f'eigh_host_{d}')
-                t = timeit(fh, cov, iters=max(3, args.iters // 4))
-                report(f'eigh_host_{d}', t)
+                measured(f'eigh_host_{d}',
+                         lambda n: timeit(fh, cov, iters=n), qiters)
 
                 # cholesky factor + solve against identity (INVERSE method)
                 def chol_inv(c):
@@ -394,21 +458,24 @@ def main():
                         l, jnp.eye(d, dtype=c.dtype)
                     )
 
-                announce(f'cholesky_inv_{d}')
-                t = timeit(jax.jit(chol_inv), cov,
-                           iters=max(3, args.iters // 4))
-                report(f'cholesky_inv_{d}', t)
+                measured(f'cholesky_inv_{d}',
+                         lambda n: timeit(jax.jit(chol_inv), cov, iters=n),
+                         qiters)
 
                 # Newton-Schulz damped inverse: 2*iters MXU matmuls, the
                 # library's TPU default (default_compute_method)
                 ns = jax.jit(lambda c: newton_schulz_inverse(c, 0.003))
-                announce(f'newton_schulz25_{d}')
-                t = timeit(ns, cov, iters=max(3, args.iters // 4))
-                x = ns(cov)
-                err = float(jnp.abs(
-                    x @ (cov + 0.003 * jnp.eye(d)) - jnp.eye(d)
-                ).max())
-                report(f'newton_schulz25_{d}', t, residual_inf=round(err, 6))
+
+                def ns_residual(_t):
+                    x = ns(cov)
+                    err = float(jnp.abs(
+                        x @ (cov + 0.003 * jnp.eye(d)) - jnp.eye(d)
+                    ).max())
+                    return {'residual_inf': round(err, 6)}
+
+                measured(f'newton_schulz25_{d}',
+                         lambda n: timeit(ns, cov, iters=n), qiters,
+                         post=ns_residual)
 
             # covariance: XLA dense contraction vs Pallas triangular kernel
             for dt, tag in ((jnp.float32, 'f32'), (jnp.bfloat16, 'bf16')):
@@ -423,24 +490,25 @@ def main():
                 t = timeit(dense, md, iters=args.iters)
                 report(f'cov_dense_{d}_{tag}', t)
                 if run_pallas:
-                    try:
-                        from kfac_tpu.ops import pallas_cov
+                    from kfac_tpu.ops import pallas_cov
 
-                        announce(f'cov_pallas_{d}_{tag}')
-                        t = timeit(
-                            jax.jit(lambda a: pallas_cov.sym_cov(a)), md,
-                            iters=args.iters,
-                        )
-                        got = pallas_cov.sym_cov(md)
-                        want = dense(md).astype(got.dtype)
+                    def cov_check(_t, _md=md, _dense=dense):
+                        got = pallas_cov.sym_cov(_md)
+                        want = _dense(_md).astype(got.dtype)
                         err = float(jnp.abs(
-                            got.astype(jnp.float32) - want.astype(jnp.float32)
+                            got.astype(jnp.float32)
+                            - want.astype(jnp.float32)
                         ).max())
-                        report(f'cov_pallas_{d}_{tag}', t,
-                               max_err=round(err, 5))
-                    except Exception as exc:  # noqa: BLE001
-                        report(f'cov_pallas_{d}_{tag}', float('nan'),
-                               error=f'{type(exc).__name__}: {exc}')
+                        return {'max_err': round(err, 5)}
+
+                    measured(
+                        f'cov_pallas_{d}_{tag}',
+                        lambda n, _md=md: timeit(
+                            jax.jit(lambda a: pallas_cov.sym_cov(a)), _md,
+                            iters=n,
+                        ),
+                        args.iters, post=cov_check,
+                    )
 
     if args.resnet:
         bench_resnet50_inverse_update(args.iters)
