@@ -35,7 +35,7 @@ def dense_causal_attention(q, k, v):
     from kfac_tpu.ops import pallas_attention as pa
 
     if pa.use_flash_for(
-        q.shape[1], k.shape[1], q.shape[-1], q.dtype.itemsize
+        q.shape[1], k.shape[1], q.shape[-1], q.dtype.itemsize, dense=True
     ):
         out = _finish(pa.flash_attention_partials(q, k, v, causal=True))
         return out.astype(q.dtype)

@@ -274,10 +274,29 @@ def _call(kern, offs, q, k, v, b, h, s_q, s_k, d, block_q, n_q, interpret):
 _VMEM_KV_BYTES = 8 * 1024 * 1024
 
 
-def use_flash_for(s_q: int, s_k: int, d: int, itemsize: int = 4) -> bool:
-    """Dispatch heuristic: the kernel needs whole lane-aligned tiles, and
-    the staged K+V chunks must fit the VMEM budget. Gated behind
-    ``KFAC_TPU_PALLAS`` until validated on a real chip
+# measured on-chip win regimes (TPU v5 lite, run 20260731_034720,
+# BENCH_TPU.md / micro_full.jsonl):
+# - DENSE single-device attention competes against XLA's fused
+#   softmax(QK^T)V: the flagship with kernels enabled ran slower at
+#   s=512, so the dense path only dispatches flash at s_k >= 2048 where
+#   the S x S HBM materialization the kernel eliminates is large.
+# - The BLOCKWISE-PARTIALS form (ring/zigzag steps) competes against
+#   attend_partials_einsum, which must materialize unfused (acc, m, l)
+#   partials; the kernel computed the same partials 300x faster at the
+#   measured s=2048 and has no measured loss regime, so no length floor
+#   applies there.
+_MIN_FLASH_SK_DENSE = 2048
+
+
+def use_flash_for(
+    s_q: int, s_k: int, d: int, itemsize: int = 4, dense: bool = False
+) -> bool:
+    """Dispatch heuristic: the kernel needs whole lane-aligned tiles and
+    the staged K+V chunks must fit the VMEM budget; the single-device
+    dense path (``dense=True``) additionally requires the measured
+    on-chip win length (``_MIN_FLASH_SK_DENSE``) because its alternative
+    is XLA's fully-fused attention rather than the unfused einsum
+    partials. Overridable via ``KFAC_TPU_PALLAS``
     (:mod:`kfac_tpu.ops.pallas_gate`)."""
     from kfac_tpu.ops import pallas_gate
 
@@ -286,6 +305,7 @@ def use_flash_for(s_q: int, s_k: int, d: int, itemsize: int = 4) -> bool:
         and jax.default_backend() == 'tpu'
         and s_q % BLOCK_Q == 0
         and s_k % BLOCK_K == 0
+        and (not dense or s_k >= _MIN_FLASH_SK_DENSE)
         and d % 128 == 0
         and 2 * s_k * d * itemsize <= _VMEM_KV_BYTES
     )

@@ -16,9 +16,11 @@ a gather). :func:`sym_cov_spmd` wraps the kernel in
 each device runs the triangular kernel on its row shard and the partial
 covariances all-reduce over the row-sharding axes — the same schedule GSPMD
 derives for a plain ``a^T a`` contraction, minus the redundant lower
-triangle. ``ops.cov.get_cov`` dispatches here on TPU for factor dims
-spanning ≥ 2 MXU tiles (:func:`use_pallas_for`); inside ``shard_map``
-(manual axes) the raw kernel runs directly on the local rows.
+triangle. ``ops.cov.get_cov`` dispatches here on TPU for f32 inputs with
+factor dims spanning ≥ 2 MXU tiles — the measured on-chip win regime
+(:func:`use_pallas_for`; at bf16 XLA's native contraction is faster);
+inside ``shard_map`` (manual axes) the raw kernel runs directly on the
+local rows.
 """
 
 from __future__ import annotations
@@ -150,10 +152,19 @@ sym_cov_spmd.def_partition(
 )
 
 
-def use_pallas_for(d: int) -> bool:
-    """Heuristic: the kernel pays off on TPU once the factor dim spans
-    multiple tiles (small factors are latency-bound either way). Gated
-    behind ``KFAC_TPU_PALLAS`` until validated on a real chip
+def use_pallas_for(d: int, dtype) -> bool:
+    """Heuristic: dispatch the kernel only in its measured on-chip win
+    regime (TPU v5 lite, run 20260731_034720, BENCH_TPU.md):
+
+    - factor dim spanning >= 2 MXU tiles (small factors are
+      latency-bound either way), and
+    - f32 inputs: the triangular kernel measured ~5x faster than XLA's
+      dense contraction at f32 (14-17 ms vs 72-83 ms, d=256..2048) but
+      SLOWER at bf16 (127-161 ms vs 77-85 ms), where XLA's native-input
+      matmul beats the kernel's in-VMEM f32 accumulation layout.
+
+    ``dtype`` is required so a call site cannot silently re-open the
+    measured-loss bf16 regime. Overridable via ``KFAC_TPU_PALLAS``
     (:mod:`kfac_tpu.ops.pallas_gate`)."""
     from kfac_tpu.ops import pallas_gate
 
@@ -161,4 +172,5 @@ def use_pallas_for(d: int) -> bool:
         pallas_gate.enabled('cov')
         and jax.default_backend() == 'tpu'
         and d >= 2 * TILE
+        and jnp.dtype(dtype) == jnp.float32
     )

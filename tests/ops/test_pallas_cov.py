@@ -35,7 +35,8 @@ def test_sym_cov_scale_and_dtype():
 
 def test_use_pallas_heuristic_cpu_off():
     # on the CPU test backend the dispatch heuristic must stay off
-    assert not pallas_cov.use_pallas_for(4096)
+    import jax.numpy as jnp
+    assert not pallas_cov.use_pallas_for(4096, jnp.float32)
 
 
 def test_sym_cov_spmd_row_sharded_matches_dense():
@@ -62,7 +63,8 @@ def test_get_cov_dispatches_to_pallas(monkeypatch):
 
     from kfac_tpu.ops import cov
 
-    monkeypatch.setattr(pallas_cov, 'use_pallas_for', lambda d: True)
+    monkeypatch.setattr(pallas_cov, 'use_pallas_for',
+                        lambda d, dtype=None: True)
     a = jax.random.normal(jax.random.PRNGKey(1), (64, 32))
     ref = np.asarray(a).T @ (np.asarray(a) / 64)
     ref = (ref + ref.T) / 2

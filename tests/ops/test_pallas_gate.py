@@ -1,5 +1,6 @@
-"""The on-chip validation gate: Pallas kernels stay off the default TPU
-path unless KFAC_TPU_PALLAS opts them in (VERDICT r4 item 2)."""
+"""The Pallas dispatch gate: default ON since the round-5 on-chip
+validation, with dispatch restricted to each kernel's measured win
+regime; KFAC_TPU_PALLAS=0 restores the pure-XLA paths."""
 
 import pytest
 
@@ -9,7 +10,7 @@ from kfac_tpu.ops import pallas_attention, pallas_cov, pallas_gate
 @pytest.mark.parametrize(
     'val,cov,attn',
     [
-        (None, False, False),     # unset: default OFF
+        (None, True, True),       # unset: default ON (validated on-chip r5)
         ('0', False, False),
         ('', False, False),
         ('off', False, False),
@@ -35,12 +36,38 @@ def test_enabled_parsing(monkeypatch, val, cov, attn):
 def test_dispatch_stays_off_cpu_even_when_enabled(monkeypatch):
     # the gate only ever ADDS a restriction: enabling it off-TPU must not
     # flip the backend check
+    import jax.numpy as jnp
+
     monkeypatch.setenv('KFAC_TPU_PALLAS', '1')
-    assert not pallas_cov.use_pallas_for(4096)
+    assert not pallas_cov.use_pallas_for(4096, jnp.float32)
     assert not pallas_attention.use_flash_for(1024, 1024, 128)
 
 
-def test_dispatch_gated_off_by_default(monkeypatch):
+def test_dispatch_default_on_but_cpu_backend_off(monkeypatch):
+    # default gate is ON since the round-5 on-chip validation, but the
+    # CPU test backend still never dispatches
     monkeypatch.delenv('KFAC_TPU_PALLAS', raising=False)
-    assert not pallas_cov.use_pallas_for(4096)
+    import jax.numpy as jnp
+
+    assert pallas_gate.enabled('cov') and pallas_gate.enabled('attn')
+    assert not pallas_cov.use_pallas_for(4096, jnp.float32)
     assert not pallas_attention.use_flash_for(1024, 1024, 128)
+
+
+def test_dispatch_win_regimes(monkeypatch):
+    """Measured win regimes (BENCH_TPU.md): cov f32-only; flash s_k>=2048.
+    Verified by faking the TPU backend check."""
+    import jax as _jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv('KFAC_TPU_PALLAS', '1')
+    monkeypatch.setattr(_jax, 'default_backend', lambda: 'tpu')
+    assert pallas_cov.use_pallas_for(4096, jnp.float32)     # f32: win
+    assert not pallas_cov.use_pallas_for(4096, jnp.bfloat16)  # bf16: loss
+    assert not pallas_cov.use_pallas_for(128, jnp.float32)  # < 2 tiles
+    # dense path: XLA's fused attention wins below s=2048 (measured)
+    assert pallas_attention.use_flash_for(2048, 2048, 128, dense=True)
+    assert not pallas_attention.use_flash_for(512, 512, 128, dense=True)
+    # blockwise-partials path (ring steps): no length floor — the
+    # alternative is the unfused einsum partials the kernel beat 300x
+    assert pallas_attention.use_flash_for(512, 512, 128)
