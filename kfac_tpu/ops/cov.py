@@ -53,24 +53,30 @@ def get_cov(
         from kfac_tpu.ops import pallas_cov
 
         if pallas_cov.use_pallas_for(a.shape[1], a.dtype):
-            # A shard_map body (even one manual over a subset of mesh axes)
-            # must run the raw local kernel: custom_partitioning cannot
-            # trace inside a manual region. Detect via the mesh's axis
-            # types AND the input's varying-manual-axes set (covers
-            # check_vma=False partial shard_maps too).
-            am = jax.sharding.get_abstract_mesh()
-            manual = (
-                any('manual' in str(t).lower()
-                    for t in getattr(am, 'axis_types', ()))
-                or bool(getattr(jax.typeof(a), 'vma', ()))
-            )
-            if manual:  # shard_map body: rows are already device-local
+            # Context decides which kernel form can trace here
+            # (pallas_gate.manual_context — axis types are the reliable
+            # signal, probed on this install):
+            # - fully-manual shard_map: raw local kernel (rows are
+            #   device-local; custom_partitioning cannot trace inside a
+            #   manual region)
+            # - no manual axes: the custom_partitioning spmd wrapper
+            #   (GSPMD applies the local-kernel + psum rule — this also
+            #   covers mesh-less sharded inputs)
+            # - PARTIAL manual (e.g. the pipeline: manual pipe+data, TP
+            #   automatic): NEITHER traces — a raw Mosaic call would need
+            #   auto-partitioning over the automatic axes, which Mosaic
+            #   rejects (measured on-chip) — so fall through to XLA.
+            from kfac_tpu.ops import pallas_gate
+
+            _has_mesh, manual_any, manual_all = pallas_gate.manual_context()
+            if manual_all:  # shard_map body: rows are already device-local
                 c = pallas_cov.sym_cov(
                     a, scale=1.0, interpret=pallas_cov.interpret_mode()
                 )
-            else:
-                c = pallas_cov.sym_cov_spmd(a)
-            return c / scale
+                return c / scale
+            if not manual_any:
+                return pallas_cov.sym_cov_spmd(a) / scale
+            # partial-manual region: XLA contraction below
         cov = a.T @ (a / scale)
         return (cov + cov.T) / 2.0
     return a.T @ (b / scale)

@@ -288,11 +288,39 @@ _VMEM_KV_BYTES = 8 * 1024 * 1024
 _MIN_FLASH_SK_DENSE = 2048
 
 
+def _mosaic_context_ok() -> bool:
+    """Whether the current trace context can execute a raw ``pallas_call``.
+
+    Mosaic kernels cannot be automatically partitioned (measured on-chip:
+    ``NotImplementedError: Mosaic kernels cannot be automatically
+    partitioned`` from a flash dispatch inside the pipeline's
+    partial shard_map, whose model axis stays automatic). Safe contexts:
+
+    - a FULLY-manual shard_map region: every mesh axis manual, so the
+      kernel sees device-local blocks and GSPMD never touches it;
+    - no surrounding mesh AND a single-device process: with more than
+      one device, inputs placed via ``device_put(NamedSharding)`` can
+      arrive sharded without any mesh context and would still need GSPMD
+      to partition the kernel.
+
+    Partial-manual regions (pipeline manual over pipe+data with TP
+    automatic) and plain pjit meshes fall back to the einsum partials,
+    which XLA partitions fine.
+    """
+    from kfac_tpu.ops import pallas_gate
+
+    has_mesh, _any_manual, all_manual = pallas_gate.manual_context()
+    if has_mesh:
+        return all_manual
+    return len(jax.devices()) == 1
+
+
 def use_flash_for(
     s_q: int, s_k: int, d: int, itemsize: int = 4, dense: bool = False
 ) -> bool:
-    """Dispatch heuristic: the kernel needs whole lane-aligned tiles and
-    the staged K+V chunks must fit the VMEM budget; the single-device
+    """Dispatch heuristic: the kernel needs whole lane-aligned tiles, the
+    staged K+V chunks must fit the VMEM budget, and a trace context GSPMD
+    won't auto-partition (:func:`_mosaic_context_ok`); the single-device
     dense path (``dense=True``) additionally requires the measured
     on-chip win length (``_MIN_FLASH_SK_DENSE``) because its alternative
     is XLA's fully-fused attention rather than the unfused einsum
@@ -308,4 +336,5 @@ def use_flash_for(
         and (not dense or s_k >= _MIN_FLASH_SK_DENSE)
         and d % 128 == 0
         and 2 * s_k * d * itemsize <= _VMEM_KV_BYTES
+        and _mosaic_context_ok()
     )

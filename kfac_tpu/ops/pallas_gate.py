@@ -48,3 +48,26 @@ def enabled(kernel: str) -> bool:
     if val in _FALSE:
         return False
     return kernel in {t.strip() for t in val.split(',')}
+
+
+def manual_context() -> tuple[bool, bool, bool]:
+    """``(has_mesh, any_manual, all_manual)`` for the current trace context.
+
+    The single source of truth for whether a raw ``pallas_call`` may run
+    here (Mosaic kernels cannot be automatically partitioned). Probed on
+    this JAX install: inside shard_map regions — ``check_vma=True`` or
+    ``False`` — the abstract mesh's ``axis_types`` carries ``Manual`` for
+    exactly the manual axes; aval ``vma`` is NOT a reliable signal (empty
+    under ``check_vma=False``), so axis types alone decide.
+    """
+    import jax
+
+    am = jax.sharding.get_abstract_mesh()
+    has_mesh = bool(getattr(am, 'axis_names', ()))
+    types = getattr(am, 'axis_types', ())
+    vals = [str(t).lower()
+            for t in (types.values() if hasattr(types, 'values') else types)]
+    if not vals:
+        return has_mesh, False, False
+    flags = ['manual' in t for t in vals]
+    return has_mesh, any(flags), all(flags)
