@@ -43,7 +43,9 @@ def _make_gtap(helper: helpers_lib.LayerHelper) -> Callable[..., jax.Array]:
         return y, None
 
     def bwd(_, ybar: jax.Array):
-        return ybar, helper.get_g_factor(ybar)
+        # weighted (routed) helpers emit w_i * G_i so repeated
+        # invocations sum traffic-weighted (see g_factor_for_sum)
+        return ybar, helper.g_factor_for_sum(ybar)
 
     gtap.defvjp(fwd, bwd)
     return gtap
@@ -55,7 +57,7 @@ class CurvatureCapture:
     Usage::
 
         cap = CurvatureCapture(registry)
-        (loss, (aux, a_stats, counts)), (grads, g_stats) = cap.value_stats_and_grad(
+        (loss, aux), grads, stats = cap.value_stats_and_grad(
             loss_fn, has_aux=False)(params, batch)
 
     ``loss_fn(params, *args)`` must evaluate the flax model via
@@ -82,9 +84,12 @@ class CurvatureCapture:
         loss_fn: Callable[..., Any],
         has_aux: bool = False,
     ) -> Callable[..., Any]:
-        """Return ``f(params, gstats, *args) -> (loss, (aux, a_stats, counts))``.
+        """Return ``f(params, gstats, *args) ->
+        (loss, (aux, a_stats, counts, weights))``.
 
         Differentiating w.r.t. ``gstats`` yields the G factors.
+        ``weights`` holds per-capture evidence weights for layers whose
+        helper defines one (routed MoE layers); other layers are absent.
         """
         registry = self.registry
         gtaps = self._gtaps
@@ -92,6 +97,7 @@ class CurvatureCapture:
         def wrapped(params: Any, gstats: dict[str, jax.Array], *args: Any, **kwargs: Any):
             a_stats: dict[str, jax.Array] = {}
             counts: dict[str, jax.Array] = {}
+            weights: dict[str, jax.Array] = {}
 
             def interceptor(next_fun, iargs, ikwargs, context):
                 mod = context.module
@@ -103,12 +109,24 @@ class CurvatureCapture:
                     return next_fun(*iargs, **ikwargs)
                 a = jax.lax.stop_gradient(iargs[0])
                 a_fac = helper.get_a_factor(a)
+                if helper.weighted:
+                    # traffic-weighted accumulation: sum w_i * F_i here,
+                    # divide by sum w_i in run() — a repeated invocation
+                    # that saw no tokens contributes nothing instead of
+                    # dragging the within-capture average toward zero
+                    # (same convention as accumulate_stats/average_stats)
+                    w = helper.capture_weight(a)
+                    a_fac = a_fac * w
                 if name in a_stats:
                     a_stats[name] = a_stats[name] + a_fac
                     counts[name] = counts[name] + 1
+                    if helper.weighted:
+                        weights[name] = weights[name] + w
                 else:
                     a_stats[name] = a_fac
                     counts[name] = jnp.asarray(1, dtype=jnp.int32)
+                    if helper.weighted:
+                        weights[name] = w
                 y = next_fun(*iargs, **ikwargs)
                 return gtaps[name](y, gstats[name])
 
@@ -118,7 +136,7 @@ class CurvatureCapture:
                 loss, aux = out
             else:
                 loss, aux = out, None
-            return loss, (aux, a_stats, counts)
+            return loss, (aux, a_stats, counts, weights)
 
         return wrapped
 
@@ -139,18 +157,18 @@ class CurvatureCapture:
 
         def run(params: Any, *args: Any, **kwargs: Any):
             gstats_in = self.zero_gstats()
-            (loss, (aux, a_stats, counts)), (grads, g_stats) = grad_fn(
-                params, gstats_in, *args, **kwargs
+            (loss, (aux, a_stats, counts, weights)), (grads, g_stats) = (
+                grad_fn(params, gstats_in, *args, **kwargs)
             )
-            a_avg = {
-                n: a_stats[n] / counts[n].astype(a_stats[n].dtype)
-                for n in a_stats
+            a_avg = weighted_average(a_stats, counts, weights)
+            g_avg = weighted_average(
+                {n: g_stats[n] for n in a_stats}, counts, weights
+            )
+            w_avg = {
+                n: weights[n] / counts[n].astype(weights[n].dtype)
+                for n in weights
             }
-            g_avg = {
-                n: g_stats[n] / counts[n].astype(g_stats[n].dtype)
-                for n in a_stats
-            }
-            stats = CapturedStats(a=a_avg, g=g_avg)
+            stats = CapturedStats(a=a_avg, g=g_avg, w=w_avg)
             return (loss, aux), grads, stats
 
         return run
@@ -158,25 +176,45 @@ class CurvatureCapture:
 
 @jax.tree_util.register_pytree_node_class
 class CapturedStats:
-    """Per-batch factor statistics: name -> A and name -> G matrices."""
+    """Per-batch factor statistics: name -> A and name -> G matrices.
 
-    def __init__(self, a: dict[str, jax.Array], g: dict[str, jax.Array]):
+    ``w`` optionally carries per-layer evidence weights in [0, 1] (routed
+    MoE layers: the live-row fraction). Engines use them to weight the
+    factor EMA by actual token traffic (``alpha_eff = 1 - (1-alpha)*w``):
+    a capture where an expert saw no tokens leaves its factors unchanged
+    instead of diluting them, and light-traffic captures move the running
+    estimate proportionally less. Layers absent from ``w`` weigh 1, which
+    reduces exactly to the unweighted EMA.
+    """
+
+    def __init__(
+        self,
+        a: dict[str, jax.Array],
+        g: dict[str, jax.Array],
+        w: dict[str, jax.Array] | None = None,
+    ):
         self.a = a
         self.g = g
+        self.w = {} if w is None else w
 
     def tree_flatten(self):
         names = sorted(self.a)
-        return (
-            tuple(self.a[n] for n in names) + tuple(self.g[n] for n in names),
-            tuple(names),
+        wnames = sorted(self.w)
+        leaves = (
+            tuple(self.a[n] for n in names)
+            + tuple(self.g[n] for n in names)
+            + tuple(self.w[n] for n in wnames)
         )
+        return leaves, (tuple(names), tuple(wnames))
 
     @classmethod
-    def tree_unflatten(cls, names, leaves):
+    def tree_unflatten(cls, aux, leaves):
+        names, wnames = aux
         n = len(names)
         a = dict(zip(names, leaves[:n]))
-        g = dict(zip(names, leaves[n:]))
-        return cls(a=a, g=g)
+        g = dict(zip(names, leaves[n:2 * n]))
+        w = dict(zip(wnames, leaves[2 * n:]))
+        return cls(a=a, g=g, w=w)
 
     def scaled(self, grad_scale: jax.Array | float) -> 'CapturedStats':
         """Unscale G stats computed under a scaled loss (AMP loss scaling).
@@ -188,7 +226,58 @@ class CapturedStats:
         return CapturedStats(
             a=self.a,
             g={n: v / s2 for n, v in self.g.items()},
+            w=self.w,
         )
+
+
+# Floor for traffic-weight denominators: a fully-starved layer keeps
+# factor 0 with weight 0 (the EMA then ignores it) instead of dividing
+# 0/0. Shared by every averaging site so the convention cannot drift.
+WEIGHT_FLOOR = 1e-8
+
+
+def weighted_average(
+    sums: dict[str, jax.Array],
+    counts: dict[str, jax.Array],
+    weights: dict[str, jax.Array],
+) -> dict[str, jax.Array]:
+    """Average per-invocation accumulator sums into per-capture factors.
+
+    Weighted (routed) layers accumulated ``w_i * F_i`` and divide by
+    their summed traffic weight; others divide by the invocation count.
+    The ONE implementation of the convention — used by
+    :meth:`CurvatureCapture.value_stats_and_grad` and the EP combined
+    capture (parallel/expert_parallel.py).
+    """
+    def denom(n, dtype):
+        if n in weights:
+            return jnp.maximum(weights[n], WEIGHT_FLOOR).astype(dtype)
+        return counts[n].astype(dtype)
+
+    return {n: v / denom(n, v.dtype) for n, v in sums.items()}
+
+
+def _traffic_scaled(stats: CapturedStats) -> CapturedStats:
+    """Scale weighted (routed) layers' factors by their capture weight.
+
+    The accumulator holds ``sum_i w_i * F_i`` for weighted layers and
+    plain ``sum_i F_i`` for the rest; :func:`average_stats` divides by
+    ``sum_i w_i`` resp. ``num_steps``, so weighted layers combine as the
+    traffic-weighted mean of their micro-captures — a micro-step where an
+    expert saw no tokens contributes nothing instead of dragging the
+    average toward zero.
+    """
+    return CapturedStats(
+        a={
+            n: stats.a[n] * stats.w[n] if n in stats.w else stats.a[n]
+            for n in stats.a
+        },
+        g={
+            n: stats.g[n] * stats.w[n] if n in stats.w else stats.g[n]
+            for n in stats.g
+        },
+        w=stats.w,
+    )
 
 
 def accumulate_stats(
@@ -199,19 +288,37 @@ def accumulate_stats(
 
     Divide by the number of micro-steps with :func:`average_stats` before
     passing to ``update_factors``, mirroring the reference's accumulation
-    counter (kfac/layers/base.py:375-405).
+    counter (kfac/layers/base.py:375-405). Weighted (routed) layers
+    accumulate ``w_i * F_i`` — see :func:`_traffic_scaled`.
     """
+    new = _traffic_scaled(new)
     if acc is None:
         return new
     return CapturedStats(
         a={n: acc.a[n] + new.a[n] for n in acc.a},
         g={n: acc.g[n] + new.g[n] for n in acc.g},
+        w={n: acc.w[n] + new.w[n] for n in acc.w},
     )
 
 
 def average_stats(acc: CapturedStats, num_steps: int | jax.Array) -> CapturedStats:
-    """Average accumulated statistics over ``num_steps`` micro-steps."""
+    """Average accumulated statistics over ``num_steps`` micro-steps.
+
+    Weighted (routed) layers divide by their accumulated traffic weight
+    instead — the traffic-weighted mean ``sum(w_i F_i) / sum(w_i)`` — so
+    the combined factor matches what one capture over the concatenated
+    micro-batches would have produced (up to each micro-capture's own
+    normalization). The combined weight is the mean live fraction; a
+    layer starved across EVERY micro-step keeps factor 0 with weight 0,
+    which the engines' weighted EMA then ignores entirely.
+    """
+    def div(n, v):
+        if n in acc.w:
+            return v / jnp.maximum(acc.w[n], WEIGHT_FLOOR)
+        return v / num_steps
+
     return CapturedStats(
-        a={n: v / num_steps for n, v in acc.a.items()},
-        g={n: v / num_steps for n, v in acc.g.items()},
+        a={n: div(n, v) for n, v in acc.a.items()},
+        g={n: div(n, v) for n, v in acc.g.items()},
+        w={n: v / num_steps for n, v in acc.w.items()},
     )

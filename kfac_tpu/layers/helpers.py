@@ -42,6 +42,37 @@ class LayerHelper:
         """Per-batch A factor from the layer input (forward tap)."""
         raise NotImplementedError
 
+    @property
+    def weighted(self) -> bool:
+        """Whether this helper's captures carry an evidence weight.
+
+        The single source of truth for every weight-sensitive code path:
+        :meth:`capture_weight` returns non-None, the capture accumulates
+        traffic-weighted sums, and ``Trainer._zero_stats`` emits a
+        matching ``w`` entry — all iff this is True.
+        """
+        return False
+
+    def capture_weight(self, a: jax.Array) -> jax.Array | None:
+        """Per-capture evidence weight for the factor EMA, from the layer
+        input. ``None`` (implicit weight 1) unless :attr:`weighted`;
+        routed dense layers return their live-row fraction so the engines
+        can weight captures by actual token traffic (see
+        cov.routed_live_fraction)."""
+        del a
+        return None
+
+    def g_factor_for_sum(self, g: jax.Array) -> jax.Array:
+        """Per-invocation G contribution for the capture accumulator.
+
+        Equals :meth:`get_g_factor` for unweighted helpers. Weighted
+        (routed) helpers return the factor PRE-SCALED by its own live
+        fraction, so summing invocations and dividing by the summed
+        weights yields the traffic-weighted mean ``sum(w_i G_i)/sum(w_i)``
+        — the same convention as cross-micro-step accumulation.
+        """
+        return self.get_g_factor(g)
+
     def get_g_factor(self, g: jax.Array) -> jax.Array:
         """Per-batch G factor from dL/d(layer output) (backward tap)."""
         raise NotImplementedError
@@ -94,6 +125,22 @@ class DenseHelper(LayerHelper):
         if self.routed:
             return cov.routed_linear_g_factor(g, dtype=self.factor_dtype)
         return cov.linear_g_factor(g, dtype=self.factor_dtype)
+
+    @property
+    def weighted(self) -> bool:
+        return self.routed
+
+    def capture_weight(self, a: jax.Array) -> jax.Array | None:
+        if not self.routed:
+            return None
+        return cov.routed_live_fraction(a)
+
+    def g_factor_for_sum(self, g: jax.Array) -> jax.Array:
+        # routed G x its live fraction == the plain total-rows
+        # normalization: get_cov(g)*(rows/n) * (n/rows) = g^T g / rows
+        if self.routed:
+            return cov.linear_g_factor(g, dtype=self.factor_dtype)
+        return self.get_g_factor(g)
 
     def grads_to_matrix(self, grads: dict[str, jax.Array]) -> jax.Array:
         mat = grads['kernel'].T

@@ -186,17 +186,18 @@ def routed_linear_a_factor(
     live count can therefore undercount; the resulting overnormalization
     is bounded by 1/n_live per such row.
 
-    Exactness scope: PER CAPTURE. Across captures the engines follow the
-    standard K-FAC convention of averaging per-batch-normalized factors
-    (EMA over steps; mean over grad-accumulation micro-steps), so the
-    combined factor is an average of per-capture oracles — for routed
-    layers that weights each capture equally rather than by its live
-    count, and a capture where the expert received ZERO tokens
-    contributes an all-zero matrix. With batches large enough that every
-    expert sees traffic each capture (the regime a load-balance loss
-    maintains), this matches the oracle's own ratio-then-average
-    convention; pathologically starved experts dilute toward zero, which
-    damping floors.
+    Exactness scope: PER CAPTURE, with cross-capture traffic weighting.
+    Routed captures also emit their live-row fraction as an evidence
+    weight (:func:`routed_live_fraction`, surfaced as
+    ``CapturedStats.w``), and the dense and KAISA engines weight the
+    factor EMA by it (``alpha_eff = 1 - (1-alpha)*w``): a capture where
+    the expert received ZERO tokens leaves the running factor untouched
+    (previously its all-zero matrix diluted the EMA toward zero), and
+    light-traffic captures move the estimate proportionally less. The
+    pipeline engine's in-schedule capture keeps the equal-weight
+    convention (its stats path carries no weights); grad-accumulation
+    micro-steps average factors equally and carry the mean live fraction
+    as the combined weight.
     """
     if dtype is not None:
         a = a.astype(dtype)
@@ -206,6 +207,22 @@ def routed_linear_a_factor(
     if has_bias:
         a = jnp.concatenate([a, nz[:, None]], axis=-1)
     return get_cov(a) * (a.shape[0] / n)
+
+
+def routed_live_fraction(a: jax.Array) -> jax.Array:
+    """Fraction of rows with any nonzero entry — the per-capture evidence
+    weight for token-count-weighted factor EMA on routed layers.
+
+    Uses the same zero-row detection as :func:`routed_linear_a_factor`
+    (and shares its dead-activation caveat), so the weight and the
+    factor normalization always count the same row set. Returns a scalar
+    in [0, 1]; an expert that received no tokens this capture weighs 0,
+    which makes the engines' weighted EMA leave its running factor
+    untouched instead of diluting it toward zero.
+    """
+    a = a.reshape(-1, a.shape[-1])
+    nz = jnp.max(jnp.abs(a), axis=-1) > 0
+    return jnp.mean(nz.astype(jnp.float32))
 
 
 def routed_linear_g_factor(

@@ -30,6 +30,19 @@ def ema_update(
     return alpha * running + (1.0 - alpha) * new
 
 
+def effective_alpha(
+    alpha: float | jax.Array, w: jax.Array
+) -> jax.Array:
+    """Evidence-weighted EMA decay: ``1 - (1-alpha) * w``.
+
+    The ONE formula behind traffic-weighted factor updates (dense and
+    KAISA engines): a capture carrying weight ``w`` in [0, 1] moves the
+    running factor by ``(1-alpha)*w`` — nothing at all for a starved
+    (w=0) capture, the plain EMA step at w=1.
+    """
+    return 1.0 - (1.0 - alpha) * w
+
+
 class EigenDecomp(NamedTuple):
     """Eigendecomposition of a symmetric PSD factor.
 

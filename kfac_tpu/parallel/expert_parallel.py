@@ -178,9 +178,12 @@ class EPSwitchFFN:
     ):
         """EP forward. ``x``: (B, S, d) sharded batch-over-data+expert.
 
-        Returns ``y`` when ``gstats`` is None, else ``(y, a_stats)`` where
-        ``a_stats`` maps layer name -> A factor and differentiating w.r.t.
-        ``gstats`` yields the G factors (CurvatureCapture's contract).
+        Returns ``y`` when ``gstats`` is None, else
+        ``(y, a_stats, weights)`` where ``a_stats`` maps layer name -> A
+        factor, differentiating w.r.t. ``gstats`` yields the G factors
+        (CurvatureCapture's contract), and ``weights`` maps expert layer
+        name -> live token fraction (the evidence weight for the engines'
+        traffic-weighted factor EMA).
         """
         router, ups, downs = self._names()
         e_total = self.num_experts
@@ -248,10 +251,10 @@ class EPSwitchFFN:
             if capture:
                 # exact per-expert A factors (routed semantics): bias ones
                 # on live slots only, normalized by the GLOBAL live count
-                live_n = jax.lax.psum(
+                live_raw = jax.lax.psum(
                     jnp.sum(used, axis=-1), data_axes
                 )                                                # (E/ep,)
-                live_n = jnp.maximum(live_n, 1.0)
+                live_n = jnp.maximum(live_raw, 1.0)
                 rows_up = jnp.concatenate(
                     [bufs.astype(jnp.float32), live.astype(jnp.float32)], -1
                 )                                                # (E/ep, R, d+1)
@@ -289,7 +292,11 @@ class EPSwitchFFN:
                 a_dn = jax.lax.psum(
                     jnp.einsum('erh,erg->ehg', rows_dn, rows_dn), data_axes
                 ) / live_n[:, None, None]
-                a_stats_out = (a_router, a_up, a_dn)
+                # per-expert evidence weight (live fraction of the GLOBAL
+                # token count) for the engines' traffic-weighted factor
+                # EMA — the EP analogue of cov.routed_live_fraction
+                w_live = live_raw.astype(jnp.float32) / t_glob
+                a_stats_out = (a_router, a_up, a_dn, w_live)
             dn_lin = (
                 jnp.einsum('erh,ehd->erd', hcur, w_dn)
                 + b_dn[:, None, :]
@@ -317,7 +324,7 @@ class EPSwitchFFN:
             espec3, espec3,              # expert gstat dummies
         )
         out_specs = (
-            (P(batch_axes, None, None), P(), espec3, espec3)
+            (P(batch_axes, None, None), P(), espec3, espec3, P(axis))
             if capture
             else (P(batch_axes, None, None),)
         )
@@ -329,12 +336,16 @@ class EPSwitchFFN:
         )(x, wr, br, w_up, b_up, w_dn, b_dn, g_router, g_up, g_dn)
         if not capture:
             return out[0]
-        y, a_router, a_up, a_dn = out
+        y, a_router, a_up, a_dn, w_live = out
         a_stats = {router: a_router}
+        weights: dict[str, jax.Array] = {}
         for e in range(e_total):
             a_stats[ups[e]] = a_up[e]
             a_stats[downs[e]] = a_dn[e]
-        return y, a_stats
+            # up and down projections see the same routed token set
+            weights[ups[e]] = w_live[e]
+            weights[downs[e]] = w_live[e]
+        return y, a_stats, weights
 
     # ----------------------------------------------------------- capture
 
@@ -386,6 +397,7 @@ def combined_value_stats_and_grad(
             params[ffn._names()[0]]['kernel'].shape[0] for ffn in ep_ffns
         ]
         boxes: list[dict[str, jax.Array]] = [{} for _ in ep_ffns]
+        wboxes: list[dict[str, jax.Array]] = [{} for _ in ep_ffns]
 
         def tapped(params, flax_gstats, ep_gstats, batch):
             calls = [0] * len(ep_ffns)
@@ -402,21 +414,23 @@ def combined_value_stats_and_grad(
                             'EPSwitchFFN (distinct name_prefix) per block'
                         )
                     calls[i] += 1
-                    y, a_stats = ep_ffns[i].apply(p, x, ep_gstats[i])
+                    y, a_stats, ep_w = ep_ffns[i].apply(p, x, ep_gstats[i])
                     boxes[i].clear()
                     boxes[i].update(a_stats)
+                    wboxes[i].clear()
+                    wboxes[i].update(ep_w)
                     return y
 
                 return ffn
 
             ffns = [make_ffn(i) for i in range(len(ep_ffns))]
             if cap is not None:
-                loss, (_, a_stats, counts) = cap.tapped(
+                loss, (_, a_stats, counts, wts) = cap.tapped(
                     lambda p, b: loss_fn(p, b, ffns)
                 )(params, flax_gstats, batch)
             else:
                 loss = loss_fn(params, batch, ffns)
-                a_stats, counts = {}, {}
+                a_stats, counts, wts = {}, {}, {}
             # an uninvoked block would contribute all-zero G factors (the
             # unused dummies' gradients) with NO matching A factors —
             # silent curvature corruption; fail like the double-call case
@@ -429,30 +443,38 @@ def combined_value_stats_and_grad(
                     'every ffn in ep_ffns must run exactly once per loss '
                     'evaluation'
                 )
-            return loss, (a_stats, counts, [dict(b) for b in boxes])
+            return loss, (
+                a_stats, counts, wts,
+                [dict(b) for b in boxes], [dict(b) for b in wboxes],
+            )
 
         flax_g0 = cap.zero_gstats() if cap is not None else {}
         ep_g0 = [
             ffn.zero_gstats(d) for ffn, d in zip(ep_ffns, d_models)
         ]
-        (loss, (fa, counts, ep_a)), (grads, flax_g, ep_g) = (
+        (loss, (fa, counts, wts, ep_a, ep_w)), (grads, flax_g, ep_g) = (
             jax.value_and_grad(tapped, argnums=(0, 1, 2), has_aux=True)(
                 params, flax_g0, ep_g0, batch
             )
         )
         # interceptor stats average over repeated module calls (weight
-        # sharing), CurvatureCapture's convention; EP stats are already
-        # normalized in-body
-        a_all: dict[str, jax.Array] = {
-            n: fa[n] / counts[n].astype(fa[n].dtype) for n in fa
+        # sharing) via the shared convention (capture_lib.weighted_average:
+        # weighted layers divide by summed traffic weight, others by
+        # invocation count); EP stats are already normalized in-body
+        a_all = dict(capture_lib.weighted_average(fa, counts, wts))
+        g_all = dict(
+            capture_lib.weighted_average(
+                {n: flax_g[n] for n in fa}, counts, wts
+            )
+        )
+        w_all: dict[str, jax.Array] = {
+            n: wts[n] / counts[n].astype(wts[n].dtype) for n in wts
         }
-        g_all: dict[str, jax.Array] = {
-            n: flax_g[n] / counts[n].astype(flax_g[n].dtype) for n in fa
-        }
-        for a_i, g_i in zip(ep_a, ep_g):
+        for a_i, g_i, w_i in zip(ep_a, ep_g, ep_w):
             a_all.update(a_i)
             g_all.update(g_i)
-        stats = capture_lib.CapturedStats(a=a_all, g=g_all)
+            w_all.update(w_i)
+        stats = capture_lib.CapturedStats(a=a_all, g=g_all, w=w_all)
         return (loss, None), grads, stats
 
     return run

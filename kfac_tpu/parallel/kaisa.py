@@ -519,12 +519,37 @@ class DistributedKFAC:
         alpha = _resolve(self.config.factor_decay, state.step)
         a_stacks, g_stacks = self._stack_stats(state, stats)
         fac = NamedSharding(self.mesh, self._factor_spec())
+        # Capture weights (routed MoE layers): per-slot effective decay
+        # alpha_eff = 1 - (1-alpha)*w so the EMA moves proportionally to
+        # the evidence each layer's capture carried. Slots without a
+        # weight (ordinary layers, unexecuted layers — whose stacked stat
+        # is their own state value — and size-class padding) use w=1,
+        # which reduces exactly to the unweighted update.
+        weights = getattr(stats, 'w', None) or {}
+
+        def slot_alphas(store_bucket):
+            if not any(
+                n in weights and n in stats.a for n in store_bucket.layers
+            ):
+                return None
+            w = [
+                weights[n] if (n in weights and n in stats.a)
+                else jnp.float32(1.0)
+                for n in store_bucket.layers
+            ]
+            w += [jnp.float32(1.0)] * (store_bucket.padded - len(w))
+            return factors_lib.effective_alpha(alpha, jnp.stack(w))
 
         def ema(store, side_state, stacks):
             out = {}
             for sb in store:
                 s = jax.lax.with_sharding_constraint(stacks[sb.key], fac)
-                out[sb.key] = alpha * side_state[sb.key] + (1 - alpha) * s
+                av = slot_alphas(sb)
+                if av is None:
+                    out[sb.key] = alpha * side_state[sb.key] + (1 - alpha) * s
+                else:
+                    av = av[:, None, None].astype(s.dtype)
+                    out[sb.key] = av * side_state[sb.key] + (1 - av) * s
             return out
 
         return state._replace(

@@ -203,6 +203,21 @@ class PipelinedLM:
         self.stage_registry = registry_lib.register_model(
             self.stage, x, skip_layers=list(self.skip_layers or []),
         )
+        # the in-schedule capture averages by invocation count with no
+        # weights path; a weighted (routed) helper would come out of
+        # g_factor_for_sum pre-scaled by its live fraction and silently
+        # mis-scale G vs A — reject rather than mis-precondition
+        weighted = [
+            n for n, h in self.stage_registry.layers.items()
+            if getattr(h, 'weighted', False)
+        ]
+        if weighted:
+            raise NotImplementedError(
+                f'routed (traffic-weighted) layers {weighted} are not '
+                'supported inside pipeline stages; the pipeline capture '
+                'keeps equal-weight averaging (see '
+                'cov.routed_linear_a_factor exactness notes)'
+            )
         self._gtaps = {
             name: capture_lib._make_gtap(h)
             for name, h in self.stage_registry.layers.items()

@@ -379,14 +379,31 @@ class KFACPreconditioner:
         alpha = _resolve(self.factor_decay, state.step)
         # Layers registered but not executed by this loss_fn simply keep
         # their factors (in the reference, hooks for unexecuted modules
-        # never fire).
+        # never fire). Layers with a capture weight (routed MoE) decay by
+        # alpha_eff = 1 - (1-alpha)*w: the EMA moves proportionally to the
+        # evidence this capture actually carried — a zero-traffic expert's
+        # factors stay put instead of diluting toward zero.
+        weights = getattr(stats, 'w', None) or {}
+
+        def eff_alpha(n):
+            if n in weights:
+                return factors_lib.effective_alpha(alpha, weights[n])
+            return alpha
+
+        # the .astype pins the result to factor_dtype: a traced alpha or a
+        # float32 capture weight would otherwise promote bf16 factor state
+        # and break the step's lax.cond branch-type equality
         new_a = {
-            n: factors_lib.ema_update(state.a[n], stats.a[n].astype(self.factor_dtype), alpha)
+            n: factors_lib.ema_update(
+                state.a[n], stats.a[n].astype(self.factor_dtype), eff_alpha(n)
+            ).astype(self.factor_dtype)
             if n in stats.a else state.a[n]
             for n in state.a
         }
         new_g = {
-            n: factors_lib.ema_update(state.g[n], stats.g[n].astype(self.factor_dtype), alpha)
+            n: factors_lib.ema_update(
+                state.g[n], stats.g[n].astype(self.factor_dtype), eff_alpha(n)
+            ).astype(self.factor_dtype)
             if n in stats.g else state.g[n]
             for n in state.g
         }

@@ -202,6 +202,16 @@ class Trainer:
                 for n, h in reg.layers.items()
                 if n in executed
             },
+            # weighted (routed) layers carry a capture weight; the cond
+            # branches must produce identical pytree structures (values
+            # unused: kfac.step skips the factor EMA on exactly the
+            # no-capture steps). `weighted` is the helper contract's own
+            # predicate for "capture emits a w entry".
+            w={
+                n: jax.numpy.zeros((), jax.numpy.float32)
+                for n, h in reg.layers.items()
+                if n in executed and getattr(h, 'weighted', False)
+            },
         )
 
     def _scan_body(self, state: TrainState, batch, executed: set[str]):

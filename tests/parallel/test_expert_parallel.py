@@ -108,6 +108,14 @@ def test_ep_grads_and_stats_match_routed_interceptor_capture():
                 err_msg=f'grad mismatch: {name}/{leaf}',
             )
     assert set(s_ep.a) == set(s_ref.a) and set(s_ep.g) == set(s_ref.g)
+    # evidence weights for the traffic-weighted EMA match the routed
+    # interceptor capture's live fractions (nothing drops at this capacity)
+    assert set(s_ep.w) == set(s_ref.w)
+    for name in s_ref.w:
+        np.testing.assert_allclose(
+            float(s_ep.w[name]), float(s_ref.w[name]),
+            rtol=1e-5, atol=1e-6, err_msg=f'weight mismatch: {name}',
+        )
     for name in s_ref.a:
         np.testing.assert_allclose(
             np.asarray(s_ep.a[name]), np.asarray(s_ref.a[name]),

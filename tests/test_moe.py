@@ -388,6 +388,239 @@ def test_routed_capture_matches_per_expert_oracle_exactly():
         )
 
 
+def test_routed_capture_weights_and_weighted_ema():
+    """Routed captures carry their live-row fraction as an evidence weight
+    (``stats.w``) and both engines weight the factor EMA by it
+    (``alpha_eff = 1 - (1-alpha)*w``): a capture where an expert saw zero
+    tokens leaves its running factors unchanged instead of diluting them
+    toward zero, partial traffic follows the closed form, and layers
+    without a weight reduce exactly to the unweighted EMA."""
+    d, t, n_experts = 8, 64, 4
+    m = moe.MoEMLP(num_experts=n_experts, mlp_ratio=1)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, t, d))
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    reg = kfac_tpu.register_model(
+        m, x, routed_layers=[r'.*expert\d+_(up|down)']
+    )
+
+    def loss_fn(p, batch):
+        out = m.apply({'params': p}, batch[0])
+        return jnp.mean(out**2)
+
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+    (_, _), _, stats = run(params, (x, None))
+
+    # weights exist exactly for the routed layers and equal n_e / T
+    _, inter = m.apply({'params': params}, x, mutable=['intermediates'])
+    idx = np.asarray(inter['intermediates']['expert_index'][0]).reshape(-1)
+    assert set(stats.w) == {
+        f'expert{e}_{s}' for e in range(n_experts) for s in ('up', 'down')
+    }
+    for e in range(n_experts):
+        n_e = int((idx == e).sum())
+        np.testing.assert_allclose(
+            float(stats.w[f'expert{e}_up']), n_e / t, atol=1e-6
+        )
+
+    # dense engine: a starved capture (w=0, all-zero factors) keeps the
+    # running factors; other layers still move
+    alpha = 0.9
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg, damping=1e-3, lr=0.1, factor_decay=alpha
+    )
+    state1 = jax.jit(kfac.update_factors)(kfac.init(), stats)
+    name = 'expert0_up'
+    starved = kfac_tpu.CapturedStats(
+        a={**stats.a, name: jnp.zeros_like(stats.a[name])},
+        g={**stats.g, name: jnp.zeros_like(stats.g[name])},
+        w={**stats.w, name: jnp.float32(0.0)},
+    )
+    state2 = jax.jit(kfac.update_factors)(state1, starved)
+    np.testing.assert_allclose(
+        np.asarray(state2.a[name]), np.asarray(state1.a[name]), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(state2.g[name]), np.asarray(state1.g[name]), atol=1e-6
+    )
+    assert (
+        np.abs(
+            np.asarray(state2.a['router']) - np.asarray(state1.a['router'])
+        ).max() > 1e-8
+    )
+
+    # partial traffic: closed-form alpha_eff; unweighted layers unchanged
+    # semantics (router uses plain alpha)
+    w = 0.25
+    partial = kfac_tpu.CapturedStats(
+        a=stats.a, g=stats.g, w={**stats.w, name: jnp.float32(w)}
+    )
+    state3 = jax.jit(kfac.update_factors)(state1, partial)
+    alpha_eff = 1 - (1 - alpha) * w
+    np.testing.assert_allclose(
+        np.asarray(state3.a[name]),
+        alpha_eff * np.asarray(state1.a[name])
+        + (1 - alpha_eff) * np.asarray(stats.a[name], np.float32),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(state3.a['router']),
+        alpha * np.asarray(state1.a['router'])
+        + (1 - alpha) * np.asarray(stats.a['router'], np.float32),
+        rtol=1e-5, atol=1e-6,
+    )
+
+    # stacked KAISA engine: the starved slot keeps its factor row too
+    dk = DistributedKFAC(
+        config=kfac_tpu.KFACPreconditioner(
+            registry=reg, damping=1e-3, lr=0.1, factor_decay=alpha
+        ),
+        mesh=kaisa_mesh(grad_worker_fraction=0.5),
+    )
+    dstate1 = jax.jit(dk.update_factors)(dk.init(), stats)
+    dstate2 = jax.jit(dk.update_factors)(dstate1, starved)
+    for b in dk.buckets:
+        if name in b.layers:
+            i = b.layers.index(name)
+            np.testing.assert_allclose(
+                np.asarray(dstate2.a[b.key][i]),
+                np.asarray(dstate1.a[b.key][i]),
+                atol=1e-6,
+            )
+            # a sibling expert with traffic still moves
+            busiest = max(
+                (f'expert{e}_up' for e in range(1, n_experts)),
+                key=lambda n: float(stats.w[n]),
+            )
+            j = b.layers.index(busiest)
+            assert (
+                np.abs(
+                    np.asarray(dstate2.a[b.key][j])
+                    - np.asarray(dstate1.a[b.key][j])
+                ).max() > 1e-8
+            )
+            break
+    else:
+        raise AssertionError(f'{name} not found in any bucket')
+
+
+def test_multi_invocation_routed_capture_is_traffic_weighted():
+    """A weight-shared routed layer invoked twice per loss — once with
+    tokens, once fully starved — must capture the busy invocation's
+    oracle factors, not half of them (within-capture invocations combine
+    as sum(w_i F_i)/sum(w_i), the same convention as micro-step
+    accumulation)."""
+    import flax.linen as nn
+
+    d = 6
+
+    class TwoCall(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            shared = nn.Dense(4, name='shared')
+            # invocation 1: real rows; invocation 2: all rows masked out
+            return shared(x).sum(-1) + shared(jnp.zeros_like(x)).sum(-1)
+
+    m = TwoCall()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, d))
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    reg = kfac_tpu.register_model(m, x, routed_layers=['shared'])
+    assert reg.layers['shared'].routed
+
+    def loss_fn(p, batch):
+        return jnp.mean(m.apply({'params': p}, batch) ** 2)
+
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+    (_, _), _, stats = run(params, x)
+
+    # oracle: the busy invocation alone (all 16 rows live, bias ones)
+    xb = np.concatenate([np.asarray(x), np.ones((16, 1), np.float32)], 1)
+    np.testing.assert_allclose(
+        np.asarray(stats.a['shared']), xb.T @ xb / 16, rtol=1e-4, atol=1e-6
+    )
+    # combined weight is the mean live fraction over invocations
+    np.testing.assert_allclose(float(stats.w['shared']), 0.5, atol=1e-6)
+
+
+def test_weighted_ema_preserves_bf16_factor_dtype():
+    """The weighted EMA must not promote bfloat16 factor state to float32
+    (the float32 capture weight would otherwise break kfac.step's
+    lax.cond branch-type equality)."""
+    m = moe.MoEMLP(num_experts=4, mlp_ratio=1)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 64, 8))
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    reg = kfac_tpu.register_model(
+        m, x, routed_layers=[r'.*expert\d+_(up|down)'],
+        factor_dtype=jnp.bfloat16,
+    )
+
+    def loss_fn(p, batch):
+        return jnp.mean(m.apply({'params': p}, batch[0]) ** 2)
+
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+    (_, _), grads, stats = run(params, (x, None))
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg, damping=1e-3, lr=0.1, factor_dtype=jnp.bfloat16,
+        factor_update_steps=1, inv_update_steps=1,
+    )
+    state, pg = jax.jit(kfac.step)(kfac.init(), grads, stats)
+    assert state.a['expert0_up'].dtype == jnp.bfloat16
+    assert all(np.isfinite(np.asarray(v, np.float32)).all()
+               for lv in pg.values() for v in lv.values())
+
+
+def test_accumulated_routed_stats_are_traffic_weighted():
+    """Gradient accumulation combines routed micro-captures by traffic:
+    an expert that saw tokens only in micro-step 1 (factor F, w=1) and
+    none in micro-step 2 (factor 0, w=0) must average to F — not F/2,
+    which would systematically understate the per-expert covariance.
+    Unweighted layers keep the plain mean."""
+    from kfac_tpu.layers import capture as capture_lib
+
+    f = jnp.eye(3) * 2.0
+    plain = jnp.ones((2, 2))
+    s1 = kfac_tpu.CapturedStats(
+        a={'e': f, 'd': plain}, g={'e': f, 'd': plain},
+        w={'e': jnp.float32(1.0)},
+    )
+    s2 = kfac_tpu.CapturedStats(
+        a={'e': jnp.zeros_like(f), 'd': 3.0 * plain},
+        g={'e': jnp.zeros_like(f), 'd': 3.0 * plain},
+        w={'e': jnp.float32(0.0)},
+    )
+    acc = capture_lib.accumulate_stats(None, s1)
+    acc = capture_lib.accumulate_stats(acc, s2)
+    avg = capture_lib.average_stats(acc, 2)
+    np.testing.assert_allclose(np.asarray(avg.a['e']), np.asarray(f))
+    np.testing.assert_allclose(np.asarray(avg.g['e']), np.asarray(f))
+    np.testing.assert_allclose(np.asarray(avg.a['d']), 2.0 * np.ones((2, 2)))
+    np.testing.assert_allclose(float(avg.w['e']), 0.5)
+
+    # partial traffic: w=0.75 then w=0.25 combines as (0.75*F1+0.25*F2)/1.0
+    f2 = jnp.eye(3)
+    t1 = kfac_tpu.CapturedStats(
+        a={'e': f}, g={'e': f}, w={'e': jnp.float32(0.75)}
+    )
+    t2 = kfac_tpu.CapturedStats(
+        a={'e': f2}, g={'e': f2}, w={'e': jnp.float32(0.25)}
+    )
+    avg2 = capture_lib.average_stats(
+        capture_lib.accumulate_stats(capture_lib.accumulate_stats(None, t1), t2), 2
+    )
+    np.testing.assert_allclose(
+        np.asarray(avg2.a['e']), np.asarray(0.75 * f + 0.25 * f2), rtol=1e-6
+    )
+    # fully-starved across every micro-step: factor 0, weight 0 (EMA skips)
+    z = kfac_tpu.CapturedStats(
+        a={'e': jnp.zeros_like(f)}, g={'e': jnp.zeros_like(f)},
+        w={'e': jnp.float32(0.0)},
+    )
+    avg3 = capture_lib.average_stats(
+        capture_lib.accumulate_stats(capture_lib.accumulate_stats(None, z), z), 2
+    )
+    np.testing.assert_allclose(np.asarray(avg3.a['e']), 0.0)
+    np.testing.assert_allclose(float(avg3.w['e']), 0.0)
+
+
 def test_routed_layers_rejects_non_dense():
     import flax.linen as nn
     import pytest as _pytest
