@@ -257,7 +257,13 @@ def _timeit(step_for_iter, args, warmup: int = 5, iters: int = 100) -> float:
 
     out = None
     for i in range(warmup):
+        # per-iteration announcements: warmup i=0 is the capture-variant
+        # compile, i=1 the plain variant — a stalled run's last stderr
+        # line names which program wedged (the r5s3 lm_large lesson)
+        t0 = time.perf_counter()
         out = step_for_iter(i)(*args)
+        jax.block_until_ready(out)
+        _log(f'  warmup {i}: {time.perf_counter() - t0:.1f}s')
         args = (out[0], out[1], out[2], args[3])
     jax.block_until_ready(out)
     start = time.perf_counter()
@@ -277,21 +283,25 @@ _LM_CONFIGS = {
     # minimum compile cost before paying for the flagship
     'tiny': dict(batch=4, seq=128, d_model=128, layers=2, vocab=512),
     'flagship': dict(batch=16, seq=512, d_model=512, layers=6, vocab=8192),
+    # manual-only configs (not in the orchestrator plan; run via
+    # `bench.py --stage lm --config <name>` in a chip session):
+    # 'large' amortizes tunnel dispatch over bigger matmuls for an honest
+    # MFU reading; 'longctx' puts s_k=2048 attention in range of the flash
+    # kernel's measured win regime for an end-to-end A/B.
+    'large': dict(batch=8, seq=1024, d_model=1024, layers=8, vocab=8192),
+    'longctx': dict(batch=4, seq=2048, d_model=512, layers=6, vocab=8192),
 }
 
 
-def run_lm_stage(config_name: str, out_path: str) -> None:
-    """Measure SGD vs K-FAC LM throughput at one config; write phase-by-
-    phase partials to ``out_path`` so a watchdog kill preserves everything
-    measured so far."""
-    cfg = _LM_CONFIGS[config_name]
-    result: dict = {'stage': f'lm_{config_name}', 'run_id': _RUN_ID}
+def _claim_backend(result: dict, out_path: str, tag: str):
+    """First backend touch under a watchdog; records platform fields.
 
+    Backend init can hang unkillably (C-level) if the tunnel's
+    single-client claim wasn't released; guarantee this process exits
+    with a diagnosable record instead of eating the whole stage budget.
+    """
     import jax
 
-    # Backend init can hang unkillably (C-level) if the tunnel's
-    # single-client claim wasn't released; guarantee this process exits
-    # with a diagnosable record instead of eating the whole stage budget.
     def _watchdog_fire():
         try:
             result['error'] = 'backend init hung past the 180s watchdog'
@@ -306,13 +316,23 @@ def run_lm_stage(config_name: str, out_path: str) -> None:
         dev = jax.devices()[0]
     finally:
         watchdog.cancel()
-    on_tpu = dev.platform != 'cpu'
     result['platform'] = dev.platform
     result['device_kind'] = getattr(dev, 'device_kind', '')
-    _log(f'lm_{config_name}: backend up: {dev.platform} '
-         f'{result["device_kind"]}')
+    _log(f'{tag}: backend up: {dev.platform} {result["device_kind"]}')
     _atomic_write(out_path, result)
+    return dev
 
+
+def run_lm_stage(config_name: str, out_path: str) -> None:
+    """Measure SGD vs K-FAC LM throughput at one config; write phase-by-
+    phase partials to ``out_path`` so a watchdog kill preserves everything
+    measured so far."""
+    cfg = _LM_CONFIGS[config_name]
+    result: dict = {'stage': f'lm_{config_name}', 'run_id': _RUN_ID}
+    dev = _claim_backend(result, out_path, f'lm_{config_name}')
+    on_tpu = dev.platform != 'cpu'
+
+    import jax
     import jax.numpy as jnp
     import optax
 
@@ -483,6 +503,117 @@ def run_lm_stage(config_name: str, out_path: str) -> None:
         # on trust, not a measurement
         result['timing_suspect'] = True
     _atomic_write(out_path, result)
+
+
+# ---------------------------------------------------------------------------
+# ResNet measurement stage (manual-only: `bench.py --stage resnet --config X`)
+# ---------------------------------------------------------------------------
+
+_RESNET_CONFIGS = {
+    # BASELINE.json's vision configs (the reference's CIFAR/ImageNet
+    # entrypoints, examples/torch_cifar10_resnet.py and
+    # torch_imagenet_resnet.py), shape-faithful synthetic batches
+    'resnet32_cifar': dict(arch='resnet32', batch=256, hw=32, classes=10),
+    'resnet50_imagenet': dict(arch='resnet50', batch=32, hw=224, classes=1000),
+}
+
+
+def run_resnet_stage(config_name: str, out_path: str) -> None:
+    """SGD vs K-FAC ResNet step throughput at the reference's ImageNet
+    cadence (factors every 10 steps, inverses every 100). Phase-by-phase
+    partials go to ``out_path``; MFU uses XLA's own cost model for the
+    conv FLOPs (the 6N rule only covers matmul parameters)."""
+    cfg = _RESNET_CONFIGS[config_name]
+    result: dict = {
+        'stage': config_name, 'run_id': _RUN_ID,
+        'model_config': f"{cfg['arch']}_b{cfg['batch']}_{cfg['hw']}px",
+    }
+    dev = _claim_backend(result, out_path, config_name)
+    on_tpu = dev.platform != 'cpu'
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import kfac_tpu
+    from kfac_tpu import training as training_lib
+    from kfac_tpu.models import resnet as resnet_lib
+
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    batch, hw, classes = cfg['batch'], cfg['hw'], cfg['classes']
+    model = getattr(resnet_lib, cfg['arch'])(num_classes=classes, dtype=dtype)
+    x = jax.random.normal(jax.random.PRNGKey(0), (batch, hw, hw, 3), dtype)
+    y = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, classes)
+    variables = model.init(jax.random.PRNGKey(2), x, train=True)
+    registry = kfac_tpu.register_model(model, x, train=False)
+    result['n_kfac_layers'] = len(registry)
+
+    def loss_fn(params, model_state, b):
+        xb, yb = b
+        logits, updates = model.apply(
+            {'params': params, 'batch_stats': model_state}, xb, train=True,
+            mutable=['batch_stats'],
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, yb[:, None], axis=1).mean()
+        return nll, updates['batch_stats']
+
+    opt = optax.sgd(0.1, momentum=0.9)
+    data = (x, y)
+
+    def time_trainer(trainer, warmup: int = 5, iters: int = 100) -> float:
+        # Warmup compiles both cadence variants (step 0 captures+inverts);
+        # the measured window (steps 5..104) then spans 10 factor captures
+        # and the step-100 inverse — the full cadence at true proportion,
+        # matching _timeit's accounting for the LM stages.
+        state = trainer.init(variables['params'], variables['batch_stats'])
+        loss = None
+        for _ in range(warmup):
+            state, loss = trainer.step(state, data)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, loss = trainer.step(state, data)
+        jax.block_until_ready(loss)
+        return (time.perf_counter() - t0) / iters
+
+    sgd_tr = training_lib.Trainer(loss_fn=loss_fn, optimizer=opt)
+    _log(f'{config_name}: timing SGD (compile + 100 iters)')
+    t_sgd = time_trainer(sgd_tr)
+    result['sgd_images_per_sec'] = round(batch / t_sgd, 1)
+    try:
+        state0 = sgd_tr.init(variables['params'], variables['batch_stats'])
+        ca = sgd_tr._jit_no_stats.lower(state0, data).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        result['step_gflops_xla'] = round(float(ca['flops']) / 1e9, 2)
+    except Exception as exc:  # cost-model availability varies by backend
+        _log(f'{config_name}: cost_analysis unavailable ({exc})')
+    _atomic_write(out_path, result)
+    _log(f'{config_name}: sgd {t_sgd * 1e3:.1f} ms/step; timing K-FAC '
+         '(factors/10, inverses/100)')
+
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=registry, damping=0.003, lr=0.1,
+        factor_update_steps=10, inv_update_steps=100,
+    )
+    kfac_tr = training_lib.Trainer(loss_fn=loss_fn, optimizer=opt, kfac=kfac)
+    t_kfac = time_trainer(kfac_tr)
+    peak = _peak_flops(result['device_kind']) if on_tpu else None
+    gflops = result.get('step_gflops_xla')
+    result.update(
+        kfac_images_per_sec=round(batch / t_kfac, 1),
+        value=round(batch / t_kfac, 1),
+        vs_baseline=round(t_sgd / t_kfac, 4),
+        mfu=(round(gflops * 1e9 / t_kfac / peak, 4)
+             if peak and gflops else None),
+        sgd_mfu=(round(gflops * 1e9 / t_sgd / peak, 4)
+                 if peak and gflops else None),
+        ok=True,
+    )
+    _atomic_write(out_path, result)
+    _log(f'{config_name}: kfac {t_kfac * 1e3:.1f} ms/step '
+         f'({result["vs_baseline"]:.3f}x SGD)')
 
 
 # ---------------------------------------------------------------------------
@@ -741,13 +872,26 @@ def _orchestrate(result: dict) -> None:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument('--stage', choices=['lm'])
-    parser.add_argument('--config', choices=sorted(_LM_CONFIGS))
+    parser.add_argument('--stage', choices=['lm', 'resnet'])
+    parser.add_argument(
+        '--config', choices=sorted(_LM_CONFIGS) + sorted(_RESNET_CONFIGS)
+    )
     parser.add_argument('--out')
     args = parser.parse_args()
 
-    if args.stage == 'lm':
-        run_lm_stage(args.config, args.out)
+    if args.config and not args.stage:
+        parser.error('--config requires --stage (lm or resnet)')
+    if args.stage:
+        if not args.config:
+            parser.error(f'--stage {args.stage} requires --config')
+        table = _LM_CONFIGS if args.stage == 'lm' else _RESNET_CONFIGS
+        if args.config not in table:
+            parser.error(
+                f'--config {args.config} is not a {args.stage} config '
+                f'(choose from {", ".join(sorted(table))})'
+            )
+        stage_fn = run_lm_stage if args.stage == 'lm' else run_resnet_stage
+        stage_fn(args.config, args.out)
         return
 
     result = {
