@@ -884,6 +884,8 @@ def main() -> None:
     if args.stage:
         if not args.config:
             parser.error(f'--stage {args.stage} requires --config')
+        if not args.out:
+            parser.error('--stage requires --out (the stage partial path)')
         table = _LM_CONFIGS if args.stage == 'lm' else _RESNET_CONFIGS
         if args.config not in table:
             parser.error(

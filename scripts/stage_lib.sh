@@ -1,0 +1,18 @@
+# Shared stage runner for the one-shot chip session scripts. Source me.
+#
+# run_stage NAME STAGE CONFIG BUDGET_S SETTLE_S [ENV=VAL ...]
+# Runs `bench.py --stage STAGE --config CONFIG` under a timeout with
+# SIGTERM grace (SIGKILL mid-TPU-claim wedges the single-client tunnel
+# for >30 minutes — observed 2026-07-31), settling SETTLE_S before the
+# claim. Requires $OUT_DIR. Pins KFAC_TPU_PALLAS=0 unless overridden by
+# a trailing ENV=VAL (last assignment wins).
+run_stage() {
+  local name="$1" stage="$2" config="$3" budget="$4" settle="$5"; shift 5
+  echo "=== stage $name (budget ${budget}s, pre-settle ${settle}s) ===" >&2
+  sleep "$settle"
+  env KFAC_TPU_PALLAS=0 "$@" \
+    timeout -k 30 "$budget" \
+    python bench.py --stage "$stage" --config "$config" \
+      --out "$OUT_DIR/$name.json" 2>>"$OUT_DIR/$name.stderr"
+  echo "=== stage $name rc=$? ===" >&2
+}

@@ -1,8 +1,7 @@
 #!/usr/bin/env bash
 # On-chip measurement session (round 5, session 3): runs the manual bench
 # stages sequentially — one JAX process at a time, the axon tunnel is
-# single-client — under per-stage timeouts with SIGTERM grace (SIGKILL
-# mid-TPU-claim wedges the tunnel).
+# single-client — under per-stage timeouts with SIGTERM grace.
 #
 # Stages, highest-value-first in case the tunnel drops mid-session:
 #   1. lm_large          d1024 L8 s1024 LM  — MFU with dispatch amortized
@@ -12,6 +11,7 @@
 #   5. resnet50_imagenet the reference's ImageNet config (compile-risky: last)
 set -u
 cd "$(dirname "$0")/.."
+. scripts/stage_lib.sh
 
 RUN_ID="${BENCH_RUN_ID:-$(date +%Y%m%d_%H%M%S)}"
 OUT_DIR="bench_runs/tpu_session2_${RUN_ID}"
@@ -19,22 +19,9 @@ mkdir -p "$OUT_DIR"
 export BENCH_RUN_ID="$RUN_ID"
 export JAX_COMPILATION_CACHE_DIR="${BENCH_JAX_CACHE:-/tmp/kfac_bench_jax_cache}"
 
-run_stage() {  # name stage config budget_s extra_env...
-  local name="$1" stage="$2" config="$3" budget="$4"; shift 4
-  echo "=== stage $name (budget ${budget}s) ===" >&2
-  env KFAC_TPU_PALLAS=0 "$@" \
-    timeout -k 30 "$budget" \
-    python bench.py --stage "$stage" --config "$config" \
-      --out "$OUT_DIR/$name.json" 2>>"$OUT_DIR/$name.stderr"
-  local rc=$?
-  echo "=== stage $name rc=$rc ===" >&2
-  # let the tunnel settle between claims
-  sleep 5
-}
-
-run_stage lm_large          lm     large             700
-run_stage resnet32_cifar    resnet resnet32_cifar    700
-run_stage lm_longctx        lm     longctx           600
-run_stage lm_longctx_flash  lm     longctx           600 KFAC_TPU_PALLAS=1
-run_stage resnet50_imagenet resnet resnet50_imagenet 900
+run_stage lm_large          lm     large              700  5
+run_stage resnet32_cifar    resnet resnet32_cifar     700  5
+run_stage lm_longctx        lm     longctx            600  5
+run_stage lm_longctx_flash  lm     longctx            600  5 KFAC_TPU_PALLAS=1
+run_stage resnet50_imagenet resnet resnet50_imagenet  900  5
 echo "session done: $OUT_DIR" >&2

@@ -1,29 +1,18 @@
 #!/usr/bin/env bash
 # Follow-up chip session: re-runs the stages the first session2 lost to
-# tunnel wedging (a SIGTERM'd stage wedges the single-client tunnel for
-# minutes — settle generously before each claim) and gives lm_large the
-# budget its cold d1024 K-FAC compile needs. Keep the host core QUIET
-# while this runs: XLA compiles are host-bound and a concurrent pytest
-# run was measured to stretch them severalfold.
+# tunnel wedging and gives lm_large the budget its cold d1024 K-FAC
+# compile needs. Keep the host core QUIET while this runs: XLA compiles
+# are host-bound and a concurrent pytest run was measured to stretch
+# them severalfold.
 set -u
 cd "$(dirname "$0")/.."
+. scripts/stage_lib.sh
 
 RUN_ID="${BENCH_RUN_ID:-$(date +%Y%m%d_%H%M%S)}"
 OUT_DIR="bench_runs/tpu_session2b_${RUN_ID}"
 mkdir -p "$OUT_DIR"
 export BENCH_RUN_ID="$RUN_ID"
 export JAX_COMPILATION_CACHE_DIR="${BENCH_JAX_CACHE:-/tmp/kfac_bench_jax_cache}"
-
-run_stage() {  # name stage config budget_s settle_s extra_env...
-  local name="$1" stage="$2" config="$3" budget="$4" settle="$5"; shift 5
-  echo "=== stage $name (budget ${budget}s, pre-settle ${settle}s) ===" >&2
-  sleep "$settle"
-  env KFAC_TPU_PALLAS=0 "$@" \
-    timeout -k 30 "$budget" \
-    python bench.py --stage "$stage" --config "$config" \
-      --out "$OUT_DIR/$name.json" 2>>"$OUT_DIR/$name.stderr"
-  echo "=== stage $name rc=$? ===" >&2
-}
 
 # Wait for the tunnel to recover from any prior wedge before spending
 # stage budgets: sacrificial 60s probes, up to ~20 min.
@@ -38,5 +27,7 @@ done
 
 run_stage resnet32_cifar    resnet resnet32_cifar     700  10
 run_stage lm_large          lm     large             1500  20
-run_stage resnet50_imagenet resnet resnet50_imagenet 1200 120
+run_stage lm_longctx        lm     longctx            600  20
+run_stage lm_longctx_flash  lm     longctx            600  20 KFAC_TPU_PALLAS=1
+run_stage resnet50_imagenet resnet resnet50_imagenet 1200  60
 echo "session done: $OUT_DIR" >&2

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Chip watcher: probe the axon tunnel on a gentle cadence; when it comes
+# back, wait for any local pytest to finish (XLA compiles need the host
+# core), then run the outstanding measurement stages via tpu_session2b.sh
+# (which re-probes, settles between claims, and watchdogs each stage).
+set -u
+cd "$(dirname "$0")/.."
+
+for i in $(seq 1 80); do   # ~6h at 4.5-minute period
+  if timeout 60 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
+    echo "watch: tunnel healthy at probe $i ($(date +%H:%M:%S))" >&2
+    while pgrep -f 'python -m pytest' >/dev/null; do
+      echo "watch: pytest running; holding stages" >&2
+      sleep 60
+    done
+    bash scripts/tpu_session2b.sh
+    exit 0
+  fi
+  echo "watch: probe $i down ($(date +%H:%M:%S))" >&2
+  sleep 270
+done
+echo "watch: gave up after all probes" >&2
+exit 1
