@@ -692,6 +692,10 @@ _HEADLINE_KEYS = (
     'platform', 'device_kind', 'model_config', 'clock_check_tflops',
     'sgd_tokens_per_sec', 'eager_tokens_per_sec', 'scan_tokens_per_sec',
     'value', 'vs_baseline', 'n_params', 'mfu', 'sgd_mfu', 'timing_suspect',
+    # resnet-stage fields (never lifted to the top level: the headline
+    # pick stays lm_flagship/lm_tiny)
+    'sgd_images_per_sec', 'kfac_images_per_sec', 'n_kfac_layers',
+    'step_gflops_xla',
 )
 
 
@@ -734,10 +738,10 @@ def _orchestrate(result: dict) -> None:
     stages: dict[str, dict] = {}
     result['stages'] = stages
 
-    def lm_argv(config: str, out: str) -> list[str]:
+    def stage_argv(stage: str, config: str, out: str) -> list[str]:
         return [
             sys.executable, os.path.join(here, 'bench.py'),
-            '--stage', 'lm', '--config', config, '--out', out,
+            '--stage', stage, '--config', config, '--out', out,
         ]
 
     def micro_argv(*flags: str) -> list[str]:
@@ -782,7 +786,7 @@ def _orchestrate(result: dict) -> None:
         out = os.path.join(run_dir, 'lm_tiny.json')
         env = {'JAX_PLATFORMS': 'cpu', 'PALLAS_AXON_POOL_IPS': '', **cache_env}
         status = _run_stage(
-            'lm_tiny', lm_argv('tiny', out), env,
+            'lm_tiny', stage_argv('lm', 'tiny', out), env,
             max(120.0, min(700.0, remaining() - 120.0)),
         )
         stage = _read_json(out)
@@ -807,6 +811,15 @@ def _orchestrate(result: dict) -> None:
          {**cache_env, 'KFAC_TPU_PALLAS': '1'}, 240.0, 60.0),
         ('lm_flagship_pallas', None,
          {**cache_env, 'KFAC_TPU_PALLAS': '1'}, 600.0, 30.0),
+        # opportunistic: only run on leftover budget (reserve keeps the
+        # acc stage's slice). lm_large amortizes tunnel dispatch for an
+        # honest MFU reading (its d1024 K-FAC compile is cold-cache slow —
+        # fine to lose to the skip guard); resnet32 is the reference's
+        # CIFAR vision config.
+        ('lm_large', None, {**cache_env}, 420.0, 330.0),
+        # reserve covers acc's 60s floor PLUS the kill-path overshoot
+        # (up to 30s SIGTERM grace + 10s settle beyond the budget)
+        ('resnet32_cifar', None, {**cache_env}, 420.0, 150.0),
     ]
     for name, argv, env, cap, reserve in plan:
         budget = min(cap, remaining() - reserve)
@@ -820,10 +833,16 @@ def _orchestrate(result: dict) -> None:
                 stages[name] = {'status': 'skipped_kernels_unvalidated'}
                 _log(f'stage {name}: skipped (micro_pallas not clean)')
                 continue
-        if name.startswith('lm_'):
+        if name.startswith('lm_') or name in _RESNET_CONFIGS:
             out = os.path.join(run_dir, f'{name}.json')
-            config = 'tiny' if name == 'lm_tiny' else 'flagship'
-            status = _run_stage(name, lm_argv(config, out), env, budget)
+            if name in _RESNET_CONFIGS:
+                sargv = stage_argv('resnet', name, out)
+            else:
+                config = {'lm_tiny': 'tiny', 'lm_large': 'large'}.get(
+                    name, 'flagship'
+                )
+                sargv = stage_argv('lm', config, out)
+            status = _run_stage(name, sargv, env, budget)
             stage = _read_json(out)
             stages[name] = {'status': status, **{
                 k: stage[k] for k in _HEADLINE_KEYS if k in stage
@@ -865,6 +884,18 @@ def _orchestrate(result: dict) -> None:
     if 'value' in pallas:
         result['pallas_tokens_per_sec'] = pallas['value']
         result['pallas_mfu'] = pallas.get('mfu')
+    # opportunistic stages ride along as summary fields, never the headline
+    large = stages.get('lm_large', {})
+    if large.get('mfu') is not None:
+        result['large_mfu'] = large['mfu']
+        result['large_sgd_mfu'] = large.get('sgd_mfu')
+        result['large_tokens_per_sec'] = large.get('value')
+    r32 = stages.get('resnet32_cifar', {})
+    if 'vs_baseline' in r32:
+        result['resnet32_vs_baseline'] = r32['vs_baseline']
+        result['resnet32_kfac_images_per_sec'] = r32.get(
+            'kfac_images_per_sec'
+        )
     acc_stage({**cache_env})
     done = stages.get(result.get('headline_stage', ''), {}).get('status')
     _persist(result, partial=done != 'ok')
@@ -884,14 +915,14 @@ def main() -> None:
     if args.stage:
         if not args.config:
             parser.error(f'--stage {args.stage} requires --config')
-        if not args.out:
-            parser.error('--stage requires --out (the stage partial path)')
         table = _LM_CONFIGS if args.stage == 'lm' else _RESNET_CONFIGS
         if args.config not in table:
             parser.error(
                 f'--config {args.config} is not a {args.stage} config '
                 f'(choose from {", ".join(sorted(table))})'
             )
+        if not args.out:
+            parser.error('--stage requires --out (the stage partial path)')
         stage_fn = run_lm_stage if args.stage == 'lm' else run_resnet_stage
         stage_fn(args.config, args.out)
         return

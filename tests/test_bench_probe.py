@@ -180,3 +180,104 @@ def test_persist_disabled_with_empty_path(tmp_path, monkeypatch):
     bench._persist({'metric': 'm'})
     bench._mark_run_started()
     assert list(tmp_path.iterdir()) == []
+
+
+def test_stage_config_cli_pairing():
+    """--stage/--config/--out must be validated together at parse time —
+    a mismatch discovered after the backend claim burns a chip-session
+    stage budget (r5s3 lesson)."""
+    import subprocess
+    import sys
+
+    cases = [
+        (['--stage', 'resnet', '--config', 'large'], 'not a resnet config'),
+        (['--config', 'large'], 'requires --stage'),
+        (['--stage', 'lm'], 'requires --config'),
+        (['--stage', 'lm', '--config', 'tiny'], 'requires --out'),
+    ]
+    bench_path = bench.os.path.abspath(bench.__file__)
+    for argv, needle in cases:
+        r = subprocess.run(
+            [sys.executable, bench_path, *argv],
+            capture_output=True, text=True,
+            env={**bench.os.environ, 'JAX_PLATFORMS': 'cpu',
+                 'PALLAS_AXON_POOL_IPS': ''},
+        )
+        assert r.returncode == 2, (argv, r.returncode, r.stderr)
+        assert needle in r.stderr, (argv, r.stderr)
+
+
+def test_orchestrator_tpu_plan_routes_stages(tmp_path, monkeypatch):
+    """The TPU plan dispatches each stage with the right --stage/--config
+    pair (incl. the opportunistic lm_large / resnet32_cifar tail), gates
+    lm_flagship_pallas on micro_pallas, and lifts the flagship to the
+    headline with opportunistic results as summary fields."""
+    import json
+
+    monkeypatch.setenv('BENCH_PARTIAL_PATH', str(tmp_path / 'part.json'))
+    monkeypatch.setenv('BENCH_RUNS_DIR', str(tmp_path / 'runs'))
+    monkeypatch.setenv('BENCH_DEADLINE_S', '100000')
+    monkeypatch.setattr(bench, '_probe_backend', lambda: ('tpu', 'fake v5'))
+
+    calls = []
+
+    def fake_run_stage(name, argv, env, budget, stdout_path=None):
+        calls.append((name, argv, stdout_path))
+        # stage writes its json/jsonl record like the real subprocess
+        if name.startswith('lm_') or name in bench._RESNET_CONFIGS:
+            out = argv[argv.index('--out') + 1]
+            rec = {'platform': 'tpu', 'sgd_tokens_per_sec': 100.0,
+                   'value': 90.0, 'vs_baseline': 0.9, 'mfu': 0.3,
+                   'sgd_mfu': 0.33, 'ok': True}
+            if name in bench._RESNET_CONFIGS:
+                rec.update(kfac_images_per_sec=500.0)
+            with open(out, 'w') as f:
+                json.dump(rec, f)
+        elif stdout_path:
+            with open(stdout_path, 'w') as f:
+                f.write(json.dumps({'op': 'cov_512', 'max_err': 0.0}) + '\n')
+        return 'ok'
+
+    monkeypatch.setattr(bench, '_run_stage', fake_run_stage)
+    result = {'metric': 'm', 'value': 0.0, 'platform': 'unknown'}
+    bench._orchestrate(result)
+
+    by_name = {c[0]: c[1] for c in calls}
+    order = [c[0] for c in calls]
+    assert order[:3] == ['micro_safe', 'lm_tiny', 'lm_flagship']
+    assert order[-1] == 'acc'
+    assert {'lm_large', 'resnet32_cifar'} <= set(order)
+
+    def cfg_of(name):
+        a = by_name[name]
+        return a[a.index('--stage') + 1], a[a.index('--config') + 1]
+
+    assert cfg_of('lm_tiny') == ('lm', 'tiny')
+    assert cfg_of('lm_flagship') == ('lm', 'flagship')
+    assert cfg_of('lm_large') == ('lm', 'large')
+    assert cfg_of('resnet32_cifar') == ('resnet', 'resnet32_cifar')
+    assert result['headline_stage'] == 'lm_flagship'
+    assert result['large_mfu'] == 0.3
+    assert result['resnet32_vs_baseline'] == 0.9
+    assert result['resnet32_kfac_images_per_sec'] == 500.0
+    # the kernel-enabled flagship rode along, never the headline
+    assert result['pallas_tokens_per_sec'] == 90.0
+
+
+@pytest.mark.slow
+def test_resnet_stage_end_to_end_cpu(tmp_path, monkeypatch):
+    """The vision stage runs a real SGD-vs-K-FAC measurement on a tiny
+    config (the on-chip configs are driven by scripts/tpu_session2*.sh;
+    this guards the stage code path itself)."""
+    import json
+
+    monkeypatch.setitem(
+        bench._RESNET_CONFIGS, 'tiny_test',
+        dict(arch='resnet20', batch=4, hw=32, classes=10),
+    )
+    out = tmp_path / 'rs.json'
+    bench.run_resnet_stage('tiny_test', str(out))
+    rec = json.loads(out.read_text())
+    assert rec['ok'] and rec['vs_baseline'] > 0
+    assert rec['n_kfac_layers'] == 20
+    assert rec['sgd_images_per_sec'] > 0 and rec['kfac_images_per_sec'] > 0
