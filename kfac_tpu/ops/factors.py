@@ -352,10 +352,10 @@ def damped_inverse(
     (Newton-Schulz, then ``lax.cond``-falls back to Cholesky when the final
     residual exceeds ``NS_FALLBACK_RESIDUAL``, i.e. the factor was too
     ill-conditioned for the fp32 iteration). Note ``'auto'`` under ``vmap``
-    (the stacked KAISA buckets) lowers the cond to a select that executes
-    BOTH branches batched — correct, but it pays the Cholesky the NS path
-    exists to avoid; on TPU stacked engines prefer ``'newton_schulz'`` and
-    monitor residuals out-of-band.
+    lowers the cond to a select that executes BOTH branches batched; for
+    stacked/batched callers use :func:`batched_damped_inverse_auto`, whose
+    single scalar cond pays the Cholesky only when some slot actually
+    needs it (the stacked KAISA engine does this).
     """
     if solver == 'newton_schulz':
         return newton_schulz_inverse(factor, damping, inv_dtype, iters=iters)
@@ -371,6 +371,41 @@ def damped_inverse(
         )
         return out.astype(inv_dtype)
     return compute_inverse(factor, damping, inv_dtype)
+
+
+def batched_damped_inverse_auto(
+    stack: jax.Array,
+    damping: float | jax.Array,
+    inv_dtype: jnp.dtype = jnp.float32,
+    iters: int = 40,
+) -> jax.Array:
+    """Batched ``'auto'`` inverse paying Cholesky only when NS fails.
+
+    ``vmap(damped_inverse(..., 'auto'))`` lowers the per-matrix
+    ``lax.cond`` to a select that executes BOTH solvers for every slot —
+    the batched Cholesky is paid unconditionally. Here the Newton-Schulz
+    pass runs batched, and ONE scalar ``lax.cond`` over the whole stack
+    (a real runtime branch — legal at rank 0, e.g. inside shard_map's
+    per-device body where the stacked engine calls this) runs the
+    batched Cholesky only when some slot's residual exceeds
+    ``NS_FALLBACK_RESIDUAL``, then selects per slot. The common
+    (well-conditioned) case costs pure MXU matmuls.
+    """
+    infos = jax.vmap(
+        lambda m: newton_schulz_inverse_info(
+            m, damping, jnp.float32, max_iters=iters
+        )
+    )(stack)
+    bad = ~(infos.residual <= NS_FALLBACK_RESIDUAL)  # (n,); NaN -> bad
+
+    def fallback(_):
+        chol = jax.vmap(
+            lambda m: compute_inverse(m, damping, jnp.float32)
+        )(stack)
+        return jnp.where(bad[:, None, None], chol, infos.inverse)
+
+    out = jax.lax.cond(jnp.any(bad), fallback, lambda _: infos.inverse, None)
+    return out.astype(inv_dtype)
 
 
 def eigen_preconditioned_grad(

@@ -294,22 +294,12 @@ class DistributedKFAC:
                 'method; ignoring',
                 stacklevel=2,
             )
-        if not self._eigen and self.config.inverse_solver == 'auto':
-            import warnings as _warnings
-
-            from kfac_tpu import warnings as kfac_warnings
-
-            _warnings.warn(
-                "inverse_solver='auto' under the stacked engine runs the "
-                'Cholesky-fallback lax.cond inside vmap, which lowers to a '
-                'select that executes BOTH branches for every bucket — the '
-                'batched Cholesky is paid unconditionally, negating the '
-                "Newton-Schulz path's advantage. Prefer "
-                "inverse_solver='newton_schulz' here and monitor residuals "
-                'via ops.factors.newton_schulz_inverse_info out-of-band.',
-                kfac_warnings.TPUPerformanceWarning,
-                stacklevel=2,
-            )
+        # inverse_solver='auto' is served by
+        # factors.batched_damped_inverse_auto: one scalar runtime cond per
+        # device-local block, so the batched Cholesky runs only when some
+        # slot's Newton-Schulz residual fails (it used to be a vmapped
+        # per-slot cond -> select paying both branches unconditionally,
+        # which warranted a TPUPerformanceWarning here).
 
     # ------------------------------------------------------------ shardings
 
@@ -584,6 +574,15 @@ class DistributedKFAC:
 
     def _sharded_inv(self, stack: jax.Array, damping) -> jax.Array:
         def local(block):
+            if self.config.inverse_solver == 'auto':
+                # one scalar cond per device-local block: Cholesky runs
+                # at runtime only when some slot's NS residual fails —
+                # not the vmapped per-slot cond that lowers to a
+                # pay-both-branches select
+                return factors_lib.batched_damped_inverse_auto(
+                    block, damping, jnp.float32,
+                    self.config.newton_schulz_iters,
+                )
             return jax.vmap(
                 lambda m: factors_lib.damped_inverse(
                     m, damping, jnp.float32, self.config.inverse_solver,
@@ -668,11 +667,13 @@ class DistributedKFAC:
         inverses: ``||I - (F + damping*I) F_inv||_F / sqrt(d)``.
 
         Out-of-band quality monitoring for the stacked INVERSE engine:
-        the vmapped solve cannot surface ``NewtonSchulzInfo`` in-band
-        (under vmap a cond lowers to a select that pays both branches —
-        see the ``inverse_solver='auto'`` caveat), so callers sample this
+        the vmapped ``'newton_schulz'`` solve keeps no per-slot
+        ``NewtonSchulzInfo`` in its output, so callers sample this
         between steps (e.g. each ``inv_update_steps``) and alert on
         values above :data:`kfac_tpu.ops.factors.NS_FALLBACK_RESIDUAL`.
+        (``'auto'`` already self-corrects in-band: its single scalar
+        runtime cond — ``factors.batched_damped_inverse_auto`` — swaps
+        failed slots to the Cholesky inverse at build time.)
         Identity-padded slots report ~0. Returns
         ``{'a': {bucket_key: (L,)}, 'g': {...}}``; jit-friendly.
         """

@@ -204,6 +204,41 @@ def test_damped_inverse_auto_keeps_ns_when_converged():
     np.testing.assert_allclose(np.asarray(auto), np.asarray(direct), atol=5e-4)
 
 
+def test_batched_auto_inverse_single_branch_per_slot_fallback():
+    """batched_damped_inverse_auto: well-conditioned slots get the NS
+    inverse bitwise (the scalar cond takes the cheap branch when ALL
+    slots converge); with one pathological slot in the stack, only that
+    slot becomes the Cholesky inverse and the good slot keeps NS."""
+    rng = np.random.default_rng(17)
+    good = jnp.asarray(_random_spd(64, 19))
+    q, _ = np.linalg.qr(rng.normal(size=(64, 64)))
+    bad = jnp.asarray((q * np.logspace(-5, 4, 64)) @ q.T, jnp.float32)
+    info = factors.newton_schulz_inverse_info(bad, 1e-5, max_iters=100)
+    assert float(info.residual) > factors.NS_FALLBACK_RESIDUAL  # premise
+
+    # all-good stack: bitwise the batched NS result
+    stack = jnp.stack([good, good])
+    out = factors.batched_damped_inverse_auto(stack, 1e-5, iters=100)
+    ns_good = np.asarray(
+        factors.newton_schulz_inverse(good, 1e-5, iters=100)
+    )
+    np.testing.assert_array_equal(np.asarray(out[0]), ns_good)
+
+    # mixed stack: per-slot selection. The good slot is allclose rather
+    # than bitwise: the batched while_loop iterates until every lane
+    # stops, so it may take extra (stable) NS trips vs the solo run.
+    out = factors.batched_damped_inverse_auto(
+        jnp.stack([good, bad]), 1e-5, iters=100
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[0]), ns_good, rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out[1]),
+        np.asarray(factors.compute_inverse(bad, 1e-5)),
+    )
+
+
 def test_host_eigh_matches_xla_eigh():
     """impl='host' (pure_callback -> LAPACK) reconstructs the factor and
     agrees with the device path on eigenvalues; batched input works

@@ -532,14 +532,35 @@ def test_host_eigh_impl_matches_xla_in_stacked_engine():
         )
 
 
-def test_auto_solver_warns_under_stacked_engine():
-    """inverse_solver='auto' inside the stacked engine's vmap pays both
-    cond branches (the select lowering) — constructing the engine must say
-    so, loudly."""
-    with pytest.warns(kfac_tpu.warnings.TPUPerformanceWarning, match='auto'):
-        _setup(
-            0.5, compute_method='inverse', kl_clip=None, damping=0.01,
-            inverse_solver='auto',
+def test_auto_solver_stacked_single_runtime_branch():
+    """inverse_solver='auto' on the stacked engine runs the batched
+    Cholesky behind ONE scalar runtime cond per device-local block
+    (factors.batched_damped_inverse_auto) — no construction-time
+    TPUPerformanceWarning anymore, and on well-conditioned factors the
+    preconditioned grads match the pure newton_schulz engine."""
+    import warnings as warnings_mod
+
+    with warnings_mod.catch_warnings():
+        warnings_mod.simplefilter(
+            'error', kfac_tpu.warnings.TPUPerformanceWarning
+        )
+        out = {}
+        for solver in ('auto', 'newton_schulz'):
+            mesh, m, params, batch, reg, cfg, dk, loss_fn = _setup(
+                0.5, compute_method='inverse', kl_clip=None, damping=0.01,
+                inverse_solver=solver,
+            )
+            cap = kfac_tpu.CurvatureCapture(reg)
+            (_, _), grads, stats = cap.value_stats_and_grad(loss_fn)(
+                params, batch
+            )
+            state, pg = jax.jit(dk.step)(dk.init(), grads, stats)
+            out[solver] = pg
+    for name in out['auto']:
+        np.testing.assert_allclose(
+            np.asarray(out['auto'][name]['kernel']),
+            np.asarray(out['newton_schulz'][name]['kernel']),
+            rtol=1e-4, atol=1e-6,
         )
 
 
