@@ -8,11 +8,24 @@
 # a trailing ENV=VAL (last assignment wins).
 run_stage() {
   local name="$1" stage="$2" config="$3" budget="$4" settle="$5"; shift 5
+  run_stage_cmd "$name" "$budget" "$settle" /dev/null "$@" -- \
+    python bench.py --stage "$stage" --config "$config" \
+      --out "$OUT_DIR/$name.json"
+}
+
+# run_stage_cmd NAME BUDGET_S SETTLE_S STDOUT_PATH [ENV=VAL ...] -- CMD...
+# The generic stage protocol: banner, settle, KFAC_TPU_PALLAS=0 default
+# (trailing ENV=VAL wins), timeout with SIGTERM grace, stderr appended to
+# $OUT_DIR/NAME.stderr, rc echoed.
+run_stage_cmd() {
+  local name="$1" budget="$2" settle="$3" stdout_path="$4"; shift 4
+  local -a envs=()
+  while [[ "$1" != "--" ]]; do envs+=("$1"); shift; done
+  shift
   echo "=== stage $name (budget ${budget}s, pre-settle ${settle}s) ===" >&2
   sleep "$settle"
-  env KFAC_TPU_PALLAS=0 "$@" \
+  env KFAC_TPU_PALLAS=0 ${envs[@]+"${envs[@]}"} \
     timeout -k 30 "$budget" \
-    python bench.py --stage "$stage" --config "$config" \
-      --out "$OUT_DIR/$name.json" 2>>"$OUT_DIR/$name.stderr"
+    "$@" >"$stdout_path" 2>>"$OUT_DIR/$name.stderr"
   echo "=== stage $name rc=$? ===" >&2
 }

@@ -30,4 +30,10 @@ run_stage lm_large          lm     large             1500  20
 run_stage lm_longctx        lm     longctx            600  20
 run_stage lm_longctx_flash  lm     longctx            600  20 KFAC_TPU_PALLAS=1
 run_stage resnet50_imagenet resnet resnet50_imagenet 1200  60
+
+# time-to-target-accuracy on the vision config (north-star metric shape
+# on a REAL conv net; seconds per step on-chip vs ~1s on the host CPU)
+run_stage_cmd acc_vision 900 20 "$OUT_DIR/acc_vision.jsonl" -- \
+  python tools/bench_accuracy.py --tasks cifar_resnet20 \
+    --out "$OUT_DIR/acc_vision.md"
 echo "session done: $OUT_DIR" >&2

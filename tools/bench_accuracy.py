@@ -119,7 +119,8 @@ def _task_digits(arch: str):
         return nll, ms
 
     @jax.jit
-    def evaluate(p):
+    def evaluate(p, ms):
+        del ms
         logits = model.apply({'params': p}, xte)
         return (jnp.argmax(logits, -1) == yte).mean()
 
@@ -170,7 +171,8 @@ def _task_char_lm(depth='small'):
         return lm(p, b), ms
 
     @jax.jit
-    def evaluate(p):
+    def evaluate(p, ms):
+        del ms
         return lm(p, (xte, yte))
 
     return dict(
@@ -184,11 +186,69 @@ def _task_char_lm(depth='small'):
     )
 
 
+def _task_cifar_resnet20():
+    """The BASELINE.json vision config (reference
+    examples/torch_cifar10_resnet.py) at accuracy-harness scale: real
+    CIFAR-10 when ``KFAC_TPU_DATA_DIR`` holds cifar10.npz, else the
+    shape-faithful class-conditional synthetic set. BatchNorm state rides
+    the Trainer's model_state."""
+    from examples import data as data_lib
+    from kfac_tpu.models import resnet
+
+    data_dir = os.environ.get('KFAC_TPU_DATA_DIR') or None
+    (xtr, ytr), (xte, yte) = data_lib.cifar10(
+        data_dir, n_train=12800, n_test=2000
+    )
+    # the on-disk branch returns the FULL dataset (n_train/n_test only
+    # shape the synthetic fallback): slice before normalize so the chip
+    # session doesn't materialize 50k normalized images to keep 12.8k
+    xtr, ytr = xtr[:12800], ytr[:12800]
+    xte, yte = xte[:2000], yte[:2000]
+    if data_lib.cifar_on_disk(data_dir):
+        xtr = data_lib.normalize(
+            xtr, data_lib.CIFAR10_MEAN, data_lib.CIFAR10_STD
+        )
+        xte = data_lib.normalize(
+            xte, data_lib.CIFAR10_MEAN, data_lib.CIFAR10_STD
+        )
+    xtr, ytr = jnp.asarray(xtr), jnp.asarray(ytr)
+    xte, yte = jnp.asarray(xte), jnp.asarray(yte)
+    model = resnet.resnet20(num_classes=10)
+
+    def loss_fn(p, ms, b):
+        xx, yy = b
+        logits, upd = model.apply(
+            {'params': p, 'batch_stats': ms}, xx, train=True,
+            mutable=['batch_stats'],
+        )
+        onehot = jax.nn.one_hot(yy, 10)
+        nll = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+        return nll, upd['batch_stats']
+
+    @jax.jit
+    def evaluate(p, ms):
+        logits = model.apply(
+            {'params': p, 'batch_stats': ms}, xte, train=False
+        )
+        return (jnp.argmax(logits, -1) == yte).mean()
+
+    return dict(
+        model=model, example=xtr[:8], loss_fn=loss_fn, evaluate=evaluate,
+        data=(xtr, ytr), batch=128, lr=0.05, higher_better=True,
+        metric='test_acc', max_steps=400, eval_every=20,
+        init_kwargs=dict(train=True), register_kwargs=dict(train=False),
+        kfac_kwargs=dict(
+            damping=0.01, factor_update_steps=5, inv_update_steps=25
+        ),
+    )
+
+
 TASKS = {
     'digits_mlp': lambda: _task_digits('mlp'),
     'digits_cnn': lambda: _task_digits('cnn'),
     'char_lm': _task_char_lm,
     'char_lm_deep': lambda: _task_char_lm('deep'),
+    'cifar_resnet20': _task_cifar_resnet20,
 }
 
 
@@ -206,7 +266,12 @@ def _run_one(task: dict, use_kfac: bool, seed: int = 0):
     — not XLA compile times on this 1-core container.
     """
     model = task['model']
-    params = model.init(jax.random.PRNGKey(seed), task['example'])['params']
+    variables = model.init(
+        jax.random.PRNGKey(seed), task['example'],
+        **task.get('init_kwargs', {}),
+    )
+    params = variables['params']
+    mstate = variables.get('batch_stats')
     reg = kfac_tpu.register_model(
         model, task['example'], **task.get('register_kwargs', {})
     )
@@ -235,14 +300,14 @@ def _run_one(task: dict, use_kfac: bool, seed: int = 0):
 
     # warmup: compile the capture variant (step 0 is always a capture
     # step), the plain variant, and the eval, on a scratch state
-    scratch = trainer.init(params)
+    scratch = trainer.init(params, mstate)
     scratch, _ = trainer.step(scratch, batch_at(0))
     scratch, _ = trainer.step(scratch, batch_at(1))
-    float(evaluate(scratch.params))
+    float(evaluate(scratch.params, scratch.model_state))
     del scratch
-    trainer.resume(trainer.init(params))  # host-side cadence back to 0
+    trainer.resume(trainer.init(params, mstate))  # host cadence back to 0
 
-    state = trainer.init(params)
+    state = trainer.init(params, mstate)
     curve = []
     t0 = time.perf_counter()
     for i in range(task['max_steps']):
@@ -251,7 +316,7 @@ def _run_one(task: dict, use_kfac: bool, seed: int = 0):
             jax.block_until_ready(state.params)
             wall = time.perf_counter() - t0
             te0 = time.perf_counter()
-            m = float(evaluate(state.params))
+            m = float(evaluate(state.params, state.model_state))
             # eval time is excluded from the training clock
             t0 += time.perf_counter() - te0
             curve.append((i + 1, round(wall, 3), round(m, 4)))
@@ -269,8 +334,20 @@ def run_task(name: str, seed: int = 0) -> dict:
     task = TASKS[name]()
     _log(f'{name}: SGD run')
     sgd_curve = _run_one(task, use_kfac=False, seed=seed)
+    # per-run persistence: a watchdog kill mid-K-FAC-run must not lose
+    # the completed SGD curve (stages run under hard budgets on-chip)
+    print(
+        json.dumps({'task': name, 'phase': 'sgd_curve', 'curve': sgd_curve}),
+        flush=True,
+    )
     _log(f'{name}: K-FAC run')
     kfac_curve = _run_one(task, use_kfac=True, seed=seed)
+    print(
+        json.dumps(
+            {'task': name, 'phase': 'kfac_curve', 'curve': kfac_curve}
+        ),
+        flush=True,
+    )
     hb = task['higher_better']
     final_sgd, final_kfac = sgd_curve[-1][2], kfac_curve[-1][2]
     # self-calibrating target: the worse of the two finals — both reached
@@ -364,7 +441,9 @@ def write_report(results: list[dict], path: str, platform: str) -> None:
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument('--tasks', nargs='*', default=sorted(TASKS))
+    p.add_argument(
+        '--tasks', nargs='*', default=sorted(TASKS), choices=sorted(TASKS)
+    )
     p.add_argument('--out', default='BENCH_ACC.md')
     p.add_argument('--seed', type=int, default=0)
     args = p.parse_args()
