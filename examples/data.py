@@ -22,12 +22,31 @@ def _rng(seed: int) -> np.random.Generator:
 
 
 def synthetic_classification(
-    n: int, shape: tuple[int, ...], num_classes: int, seed: int = 0
+    n: int,
+    shape: tuple[int, ...],
+    num_classes: int,
+    seed: int = 0,
+    center_seed: int = 0,
 ):
-    """Gaussian class-conditional images: learnable but synthetic."""
+    """Gaussian class-conditional images: learnable but synthetic.
+
+    ``seed`` draws the labels and per-sample noise; ``center_seed`` draws
+    the class centers. Centers default to a FIXED seed so differently-
+    seeded splits (train vs test) share the same classification problem —
+    otherwise a model that learns the train centers faces unrelated test
+    centers and generalization is impossible by construction.
+    """
     rng = _rng(seed)
     labels = rng.integers(0, num_classes, size=n)
-    centers = rng.normal(size=(num_classes,) + shape).astype(np.float32)
+    # domain-separated center stream: seeding with (center_seed, tag)
+    # keeps it disjoint from the per-split noise stream even when
+    # center_seed == seed (a shared PCG64 stream would replay the exact
+    # words the centers consumed into the split's noise draws)
+    centers = (
+        np.random.default_rng([center_seed, 0xCE27E5])
+        .normal(size=(num_classes,) + shape)
+        .astype(np.float32)
+    )
     x = 0.5 * centers[labels] + rng.normal(size=(n,) + shape).astype(np.float32)
     return x.astype(np.float32), labels.astype(np.int32)
 

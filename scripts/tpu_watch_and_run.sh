@@ -9,8 +9,8 @@ cd "$(dirname "$0")/.."
 for i in $(seq 1 80); do   # ~6h at 4.5-minute period
   if timeout 60 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
     echo "watch: tunnel healthy at probe $i ($(date +%H:%M:%S))" >&2
-    while pgrep -f '[p]ytest' >/dev/null; do
-      echo "watch: pytest running; holding stages" >&2
+    while pgrep -f '[p]ytest|bench_[a]ccuracy' >/dev/null; do
+      echo "watch: host-bound work running; holding stages" >&2
       sleep 60
     done
     bash scripts/tpu_session2b.sh

@@ -232,13 +232,21 @@ def _task_cifar_resnet20():
         )
         return (jnp.argmax(logits, -1) == yte).mean()
 
+    # Shared lr 0.02: neither side saturates instantly (SGD at 0.05 hits
+    # its final inside 80 steps) and K-FAC is stable (at damping 0.01 it
+    # oscillates 0.76-0.99 on this loss surface; 0.1 holds the
+    # trajectory). Honest expectation on the SYNTHETIC set: near-parity —
+    # class-conditional Gaussians are an almost-linear problem with
+    # little curvature pathology for K-FAC to exploit; the real-data
+    # path (KFAC_TPU_DATA_DIR) is the measurement that mirrors the
+    # reference's CIFAR runs.
     return dict(
         model=model, example=xtr[:8], loss_fn=loss_fn, evaluate=evaluate,
-        data=(xtr, ytr), batch=128, lr=0.05, higher_better=True,
+        data=(xtr, ytr), batch=128, lr=0.02, higher_better=True,
         metric='test_acc', max_steps=400, eval_every=20,
         init_kwargs=dict(train=True), register_kwargs=dict(train=False),
         kfac_kwargs=dict(
-            damping=0.01, factor_update_steps=5, inv_update_steps=25
+            damping=0.1, factor_update_steps=5, inv_update_steps=25
         ),
     )
 
