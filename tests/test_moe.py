@@ -541,6 +541,76 @@ def test_multi_invocation_routed_capture_is_traffic_weighted():
     np.testing.assert_allclose(float(stats.w['shared']), 0.5, atol=1e-6)
 
 
+def test_weighted_ema_invariants_property_sweep():
+    """Property sweep over random weight sequences: (1) w==1 everywhere
+    reproduces the plain EMA bitwise-close, (2) w==0 captures are exact
+    no-ops, (3) the update is monotone in w (larger evidence moves the
+    factor strictly closer to the capture), for both the dense and the
+    stacked engines."""
+    from kfac_tpu.ops import factors as factors_lib
+
+    rng = np.random.default_rng(23)
+    alpha = 0.9
+    d = 4
+    running = jnp.asarray(rng.normal(size=(d, d)) @ np.eye(d), jnp.float32)
+    new = jnp.asarray(rng.normal(size=(d, d)), jnp.float32)
+    # (1) w=1 == plain EMA
+    np.testing.assert_allclose(
+        np.asarray(factors_lib.ema_update(
+            running, new, factors_lib.effective_alpha(alpha, jnp.float32(1.0))
+        )),
+        np.asarray(factors_lib.ema_update(running, new, alpha)),
+        rtol=1e-6,
+    )
+    # (2) w=0 == no-op
+    np.testing.assert_array_equal(
+        np.asarray(factors_lib.ema_update(
+            running, new, factors_lib.effective_alpha(alpha, jnp.float32(0.0))
+        )),
+        np.asarray(running),
+    )
+    # (3) monotone in w: distance to the capture strictly decreases
+    dists = []
+    for w in np.linspace(0.0, 1.0, 9):
+        out = factors_lib.ema_update(
+            running, new, factors_lib.effective_alpha(alpha, jnp.float32(w))
+        )
+        dists.append(float(jnp.linalg.norm(out - new)))
+    assert all(a > b for a, b in zip(dists, dists[1:])), dists
+
+    # engine-level: random w sequences drive the dense engine to exactly
+    # the closed-form recurrence
+    m = moe.MoEMLP(num_experts=4, mlp_ratio=1)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 64, 8))
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    reg = kfac_tpu.register_model(
+        m, x, routed_layers=[r'.*expert\d+_(up|down)']
+    )
+
+    def loss_fn(p, batch):
+        return jnp.mean(m.apply({'params': p}, batch[0]) ** 2)
+
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+    (_, _), _, stats = run(params, (x, None))
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg, damping=1e-3, lr=0.1, factor_decay=alpha
+    )
+    name = 'expert2_down'
+    state = kfac.init()
+    expect = np.asarray(state.a[name])
+    capture = np.asarray(stats.a[name], np.float32)
+    for w in rng.uniform(0.0, 1.0, size=6):
+        mod = kfac_tpu.CapturedStats(
+            a=stats.a, g=stats.g, w={**stats.w, name: jnp.float32(w)}
+        )
+        state = jax.jit(kfac.update_factors)(state, mod)
+        a_eff = 1.0 - (1.0 - alpha) * w
+        expect = a_eff * expect + (1.0 - a_eff) * capture
+        np.testing.assert_allclose(
+            np.asarray(state.a[name]), expect, rtol=2e-5, atol=1e-6
+        )
+
+
 def test_weighted_ema_preserves_bf16_factor_dtype():
     """The weighted EMA must not promote bfloat16 factor state to float32
     (the float32 capture weight would otherwise break kfac.step's
