@@ -198,9 +198,22 @@ def newton_schulz_inverse_info(
     max_iters: int = 40,
     tol: float = 1e-6,
     differentiable: bool = False,
+    x0: jax.Array | None = None,
 ) -> NewtonSchulzInfo:
     """Tikhonov-damped inverse by Newton-Schulz — matmuls only, with a
     residual-based stopping rule and convergence diagnostics.
+
+    ``x0`` optionally warm-starts the iteration — engines pass the
+    PREVIOUS inverse at each ``inv_update_steps`` refresh: the factor EMA
+    moves slowly, so the old inverse sits deep inside the quadratic
+    convergence basin and the refresh needs a handful of iterations
+    instead of the cold ~log2(kappa)+5. Safeguarded: the warm init is
+    used only when its own residual ``||I - M X0||_F/sqrt(d) < 0.5``
+    (comfortably inside the ``< 1`` convergence condition), else the
+    Gershgorin cold start runs — an all-zeros x0 (a fresh engine state)
+    therefore falls back automatically. Free: the safeguard's
+    ``M @ X0`` product is the iteration's first cached ``mx``, so a warm
+    call costs no extra matmuls over a cold one.
 
     ``X_{k+1} = X_k (2I - M X_k)`` with ``M = factor + damping*I`` converges
     quadratically to ``M^{-1}`` whenever ``||I - M X_0|| < 1``; the init
@@ -254,7 +267,6 @@ def newton_schulz_inverse_info(
     eye = jnp.eye(d, dtype=jnp.float32)
     m = f + damping * eye
     lam_max = jnp.max(jnp.sum(jnp.abs(m), axis=-1))  # Gershgorin bound
-    x0 = eye / lam_max
     sqrt_d = jnp.sqrt(jnp.asarray(d, jnp.float32))
 
     def residual(mx):
@@ -277,11 +289,26 @@ def newton_schulz_inverse_info(
         mx_new = m @ x_new
         return x_new, mx_new, residual(mx_new), resid, k + 1
 
+    if x0 is not None:
+        # safeguarded warm start: keep the caller's init only if it is
+        # well inside the convergence region, else the Gershgorin cold
+        # start (jnp.where keeps this vmap/shard_map-friendly). The
+        # m @ warm product doubles as the iteration's cached mx0, and the
+        # cold init's product is a scalar rescale of m — so the warm
+        # start costs NO extra matmul over a cold start.
+        warm = x0.astype(jnp.float32)
+        m_warm = m @ warm
+        use_warm = residual(m_warm) < 0.5
+        x0 = jnp.where(use_warm, warm, eye / lam_max)
+        mx0 = jnp.where(use_warm, m_warm, m / lam_max)
+    else:
+        x0 = eye / lam_max
+        mx0 = m / lam_max  # == m @ (eye / lam_max), sans the matmul
+
     # prev starts at inf so the first step always runs; it derives from
     # lam_max (not a fresh constant) so that under shard_map the carry init
     # has the same varying-manual-axes type as the residuals the body
     # computes from ``m``.
-    mx0 = m @ x0
     init = (x0, mx0, residual(mx0), lam_max * 0.0 + jnp.inf, 0)
     if differentiable:
         # fixed-trip scan with where-frozen lanes: same outputs as the
@@ -317,13 +344,15 @@ def newton_schulz_inverse(
     iters: int = 40,
     tol: float = 1e-6,
     differentiable: bool = False,
+    x0: jax.Array | None = None,
 ) -> jax.Array:
     """Newton-Schulz damped inverse (see ``newton_schulz_inverse_info`` for
-    the iteration, stopping rule, accuracy, and the ``differentiable``
-    fixed-trip variant for callers that differentiate through it)."""
+    the iteration, stopping rule, accuracy, warm start, and the
+    ``differentiable`` fixed-trip variant for callers that differentiate
+    through it)."""
     return newton_schulz_inverse_info(
         factor, damping, inv_dtype, max_iters=iters, tol=tol,
-        differentiable=differentiable,
+        differentiable=differentiable, x0=x0,
     ).inverse
 
 
@@ -342,6 +371,7 @@ def damped_inverse(
     inv_dtype: jnp.dtype = jnp.float32,
     solver: str = 'cholesky',
     iters: int = 40,
+    x0: jax.Array | None = None,
 ) -> jax.Array:
     """Solver-dispatched damped inverse — the single place the
     ``inverse_solver`` config option is interpreted (dense, KAISA, and
@@ -358,10 +388,12 @@ def damped_inverse(
     needs it (the stacked KAISA engine does this).
     """
     if solver == 'newton_schulz':
-        return newton_schulz_inverse(factor, damping, inv_dtype, iters=iters)
+        return newton_schulz_inverse(
+            factor, damping, inv_dtype, iters=iters, x0=x0
+        )
     if solver == 'auto':
         info = newton_schulz_inverse_info(
-            factor, damping, jnp.float32, max_iters=iters
+            factor, damping, jnp.float32, max_iters=iters, x0=x0
         )
         bad = ~(info.residual <= NS_FALLBACK_RESIDUAL)  # NaN residual -> bad
         out = jax.lax.cond(
@@ -378,6 +410,7 @@ def batched_damped_inverse_auto(
     damping: float | jax.Array,
     inv_dtype: jnp.dtype = jnp.float32,
     iters: int = 40,
+    x0: jax.Array | None = None,
 ) -> jax.Array:
     """Batched ``'auto'`` inverse paying Cholesky only when NS fails.
 
@@ -391,11 +424,18 @@ def batched_damped_inverse_auto(
     ``NS_FALLBACK_RESIDUAL``, then selects per slot. The common
     (well-conditioned) case costs pure MXU matmuls.
     """
-    infos = jax.vmap(
-        lambda m: newton_schulz_inverse_info(
-            m, damping, jnp.float32, max_iters=iters
-        )
-    )(stack)
+    if x0 is None:
+        infos = jax.vmap(
+            lambda m: newton_schulz_inverse_info(
+                m, damping, jnp.float32, max_iters=iters
+            )
+        )(stack)
+    else:
+        infos = jax.vmap(
+            lambda m, w: newton_schulz_inverse_info(
+                m, damping, jnp.float32, max_iters=iters, x0=w
+            )
+        )(stack, x0)
     bad = ~(infos.residual <= NS_FALLBACK_RESIDUAL)  # (n,); NaN -> bad
 
     def fallback(_):

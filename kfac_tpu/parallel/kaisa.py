@@ -572,8 +572,14 @@ class DistributedKFAC:
         )(stack)
         return q, d
 
-    def _sharded_inv(self, stack: jax.Array, damping) -> jax.Array:
-        def local(block):
+    def _sharded_inv(
+        self, stack: jax.Array, damping, prev: jax.Array | None = None
+    ) -> jax.Array:
+        """Batched sharded damped inverse; ``prev`` (the resident inverse
+        stack) warm-starts Newton-Schulz per slot — safeguarded inside
+        the solver, so a fresh state's zero inverses cold-start."""
+
+        def local(block, prev_block):
             if self.config.inverse_solver == 'auto':
                 # one scalar cond per device-local block: Cholesky runs
                 # at runtime only when some slot's NS residual fails —
@@ -581,19 +587,25 @@ class DistributedKFAC:
                 # pay-both-branches select
                 return factors_lib.batched_damped_inverse_auto(
                     block, damping, jnp.float32,
-                    self.config.newton_schulz_iters,
+                    self.config.newton_schulz_iters, x0=prev_block,
                 )
             return jax.vmap(
-                lambda m: factors_lib.damped_inverse(
+                lambda m, w: factors_lib.damped_inverse(
                     m, damping, jnp.float32, self.config.inverse_solver,
-                    self.config.newton_schulz_iters,
+                    self.config.newton_schulz_iters, x0=w,
                 )
-            )(block)
+            )(block, prev_block)
 
+        if prev is None:
+            prev = jnp.zeros_like(stack)
         spec = P(self.all_axes)
+        # prev stays in its own dtype (inv_dtype, typically f32): casting
+        # to a bf16 factor dtype would inflate the warm residual by
+        # eps_bf16 * kappa and reject the warm start exactly in the
+        # high-kappa regime where it saves the most
         return jax.shard_map(
-            local, mesh=self.mesh, in_specs=spec, out_specs=spec
-        )(stack)
+            local, mesh=self.mesh, in_specs=(spec, spec), out_specs=spec
+        )(stack, prev)
 
     def update_inverses(self, state: DistKFACState) -> DistKFACState:
         cfg = self.config
@@ -649,11 +661,17 @@ class DistributedKFAC:
         a_inv, g_inv = {}, {}
         for sb in self.a_store:
             a_inv[sb.key] = jax.lax.with_sharding_constraint(
-                self._sharded_inv(state.a[sb.key], damping).astype(cfg.inv_dtype), dec
+                self._sharded_inv(
+                    state.a[sb.key], damping, prev=state.a_inv[sb.key]
+                ).astype(cfg.inv_dtype),
+                dec,
             )
         for sb in self.g_store:
             g_inv[sb.key] = jax.lax.with_sharding_constraint(
-                self._sharded_inv(state.g[sb.key], damping).astype(cfg.inv_dtype), dec
+                self._sharded_inv(
+                    state.g[sb.key], damping, prev=state.g_inv[sb.key]
+                ).astype(cfg.inv_dtype),
+                dec,
             )
         return state._replace(
             a_inv=a_inv, g_inv=g_inv,

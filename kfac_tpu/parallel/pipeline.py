@@ -945,12 +945,15 @@ class PipelineKFAC:
             return adec.q, gdec.q, adec.d, gdec.d
 
         def run_inverse(_):
-            inv = lambda f: factors_lib.damped_inverse(
+            # like[0]/like[1] are the resident inverses on the INVERSE
+            # path (the qa/qg slots double as a_inv/g_inv): warm-start
+            # Newton-Schulz from them (safeguarded; zeros cold-start)
+            inv = lambda f, prev: factors_lib.damped_inverse(
                 f, damping, cfg.inv_dtype, cfg.inverse_solver,
-                cfg.newton_schulz_iters,
+                cfg.newton_schulz_iters, x0=prev,
             )
             return (
-                inv(a_mat), inv(g_mat),
+                inv(a_mat, like[0]), inv(g_mat, like[1]),
                 jnp.zeros_like(like[2]), jnp.zeros_like(like[3]),
             )
 

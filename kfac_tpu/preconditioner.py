@@ -436,12 +436,17 @@ class KFACPreconditioner:
                 else:
                     da[name], dg[name] = adec.d, gdec.d
             return state._replace(qa=qa, qg=qg, da=da, dg=dg, dgda=dgda)
-        inv = lambda f: factors_lib.damped_inverse(
+        # warm-start Newton-Schulz from the previous inverse: the factor
+        # EMA drifts slowly between inv_update_steps refreshes, so the old
+        # inverse is deep in the quadratic basin (the safeguard inside
+        # newton_schulz_inverse_info falls back to the Gershgorin cold
+        # start for the all-zeros inverses of a fresh state)
+        inv = lambda f, prev: factors_lib.damped_inverse(
             f, damping, self.inv_dtype, self.inverse_solver,
-            self.newton_schulz_iters,
+            self.newton_schulz_iters, x0=prev,
         )
-        a_inv = {n: inv(state.a[n]) for n in state.a}
-        g_inv = {n: inv(state.g[n]) for n in state.g}
+        a_inv = {n: inv(state.a[n], state.a_inv[n]) for n in state.a}
+        g_inv = {n: inv(state.g[n], state.g_inv[n]) for n in state.g}
         return state._replace(a_inv=a_inv, g_inv=g_inv)
 
     # --------------------------------------------------------- precondition

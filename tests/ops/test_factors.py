@@ -204,6 +204,36 @@ def test_damped_inverse_auto_keeps_ns_when_converged():
     np.testing.assert_allclose(np.asarray(auto), np.asarray(direct), atol=5e-4)
 
 
+def test_newton_schulz_warm_start_fewer_iters_and_safeguard():
+    """Warm-starting from a near inverse converges in strictly fewer
+    iterations to the same answer; a zeros/garbage x0 trips the
+    safeguard and reproduces the cold start bitwise."""
+    f = jnp.asarray(_random_spd(64, 31))
+    cold = factors.newton_schulz_inverse_info(f, 0.01, max_iters=40)
+    assert float(cold.residual) <= 1e-6
+
+    # near inverse: the solution for a slightly different damping
+    near = factors.newton_schulz_inverse(f, 0.0125)
+    warm = factors.newton_schulz_inverse_info(f, 0.01, max_iters=40, x0=near)
+    assert int(warm.iterations) < int(cold.iterations), (
+        int(warm.iterations), int(cold.iterations)
+    )
+    assert float(warm.residual) <= 1e-6
+    np.testing.assert_allclose(
+        np.asarray(warm.inverse), np.asarray(cold.inverse),
+        rtol=1e-4, atol=1e-6,
+    )
+
+    # safeguarded fallbacks: zeros (fresh state) and garbage both
+    # reproduce the Gershgorin cold start exactly
+    for bad in (jnp.zeros_like(f), jnp.full_like(f, 1e6)):
+        fb = factors.newton_schulz_inverse_info(f, 0.01, max_iters=40, x0=bad)
+        np.testing.assert_array_equal(
+            np.asarray(fb.inverse), np.asarray(cold.inverse)
+        )
+        assert int(fb.iterations) == int(cold.iterations)
+
+
 def test_batched_auto_inverse_single_branch_per_slot_fallback():
     """batched_damped_inverse_auto: well-conditioned slots get the NS
     inverse bitwise (the scalar cond takes the cheap branch when ALL
