@@ -477,6 +477,34 @@ def main():
                          lambda n: timeit(ns, cov, iters=n), qiters,
                          post=ns_residual)
 
+                # warm-started refresh at factor-EMA drift (the library
+                # passes the previous inverse as x0 at every
+                # inv_update_steps refresh; residual-based early exit
+                # means wall-clock ~ iterations actually taken)
+                from kfac_tpu.ops import factors as fwarm
+
+                drift = 0.95 * cov + 0.05 * jnp.eye(d, dtype=cov.dtype)
+                prev_inv = fwarm.newton_schulz_inverse(cov, 0.003)
+                warm = jax.jit(
+                    lambda c: fwarm.newton_schulz_inverse(
+                        c, 0.003, x0=prev_inv
+                    )
+                )
+
+                def warm_iters(_t):
+                    info = fwarm.newton_schulz_inverse_info(
+                        drift, 0.003, x0=prev_inv
+                    )
+                    cold = fwarm.newton_schulz_inverse_info(drift, 0.003)
+                    return {
+                        'warm_iters': int(info.iterations),
+                        'cold_iters': int(cold.iterations),
+                    }
+
+                measured(f'newton_schulz_warm_{d}',
+                         lambda n: timeit(warm, drift, iters=n), qiters,
+                         post=warm_iters)
+
             # covariance: XLA dense contraction vs Pallas triangular kernel
             for dt, tag in ((jnp.float32, 'f32'), (jnp.bfloat16, 'bf16')):
                 md = m.astype(dt)
