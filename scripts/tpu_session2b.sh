@@ -25,6 +25,11 @@ for i in $(seq 1 20); do
   sleep 30
 done
 
+# per-op signal first (cheapest; includes the warm-vs-cold NS refresh row)
+run_stage_cmd micro_safe 400 10 "$OUT_DIR/micro_safe.jsonl" -- \
+  python tools/tpu_microbench.py --sizes 512 1024 --iters 8 --rows 8192 \
+    --no-pallas
+
 run_stage resnet32_cifar    resnet resnet32_cifar     700  10
 run_stage lm_large          lm     large             1500  20
 run_stage lm_longctx        lm     longctx            600  20
