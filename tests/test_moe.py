@@ -469,38 +469,43 @@ def test_routed_capture_weights_and_weighted_ema():
         rtol=1e-5, atol=1e-6,
     )
 
-    # stacked KAISA engine: the starved slot keeps its factor row too
-    dk = DistributedKFAC(
-        config=kfac_tpu.KFACPreconditioner(
-            registry=reg, damping=1e-3, lr=0.1, factor_decay=alpha
-        ),
-        mesh=kaisa_mesh(grad_worker_fraction=0.5),
-    )
-    dstate1 = jax.jit(dk.update_factors)(dk.init(), stats)
-    dstate2 = jax.jit(dk.update_factors)(dstate1, starved)
-    for b in dk.buckets:
-        if name in b.layers:
-            i = b.layers.index(name)
-            np.testing.assert_allclose(
-                np.asarray(dstate2.a[b.key][i]),
-                np.asarray(dstate1.a[b.key][i]),
-                atol=1e-6,
-            )
-            # a sibling expert with traffic still moves
-            busiest = max(
-                (f'expert{e}_up' for e in range(1, n_experts)),
-                key=lambda n: float(stats.w[n]),
-            )
-            j = b.layers.index(busiest)
-            assert (
-                np.abs(
-                    np.asarray(dstate2.a[b.key][j])
-                    - np.asarray(dstate1.a[b.key][j])
-                ).max() > 1e-8
-            )
-            break
-    else:
-        raise AssertionError(f'{name} not found in any bucket')
+    # stacked KAISA engine: the starved slot keeps its factor row too —
+    # under BOTH transports (the bucketed path packs factor triangles
+    # into flat buffers before stacking; the weighted alpha must land on
+    # the same slots after the round trip)
+    for method in ('allreduce', 'allreduce_bucketed'):
+        dk = DistributedKFAC(
+            config=kfac_tpu.KFACPreconditioner(
+                registry=reg, damping=1e-3, lr=0.1, factor_decay=alpha,
+                allreduce_method=method,
+            ),
+            mesh=kaisa_mesh(grad_worker_fraction=0.5),
+        )
+        dstate1 = jax.jit(dk.update_factors)(dk.init(), stats)
+        dstate2 = jax.jit(dk.update_factors)(dstate1, starved)
+        for b in dk.buckets:
+            if name in b.layers:
+                i = b.layers.index(name)
+                np.testing.assert_allclose(
+                    np.asarray(dstate2.a[b.key][i]),
+                    np.asarray(dstate1.a[b.key][i]),
+                    atol=1e-6, err_msg=method,
+                )
+                # a sibling expert with traffic still moves
+                busiest = max(
+                    (f'expert{e}_up' for e in range(1, n_experts)),
+                    key=lambda n: float(stats.w[n]),
+                )
+                j = b.layers.index(busiest)
+                assert (
+                    np.abs(
+                        np.asarray(dstate2.a[b.key][j])
+                        - np.asarray(dstate1.a[b.key][j])
+                    ).max() > 1e-8
+                ), method
+                break
+        else:
+            raise AssertionError(f'{name} not found in any bucket')
 
 
 def test_multi_invocation_routed_capture_is_traffic_weighted():
