@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -31,6 +31,11 @@ test-unit:
 
 test-integration:
 	$(TEST_ENV) $(PY) -m pytest tests/integration/ -q
+
+# numerical-health sentinel fault-injection suite (includes its slow
+# distributed cases; see docs/ROBUSTNESS.md)
+faults:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q -m faults
 
 bench:
 	$(PY) bench.py

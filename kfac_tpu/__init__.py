@@ -8,7 +8,9 @@ checkpointing — built on pjit/shard_map collectives instead of
 torch.distributed.
 """
 
-from kfac_tpu import checkpoint, enums, hyperparams, tracing, warnings
+from kfac_tpu import compat  # noqa: F401  (installs JAX API shims first)
+from kfac_tpu import checkpoint, enums, health, hyperparams, tracing, warnings
+from kfac_tpu.health import HealthConfig, HealthState
 from kfac_tpu.preconditioner import default_compute_method
 from kfac_tpu.enums import (
     AllreduceMethod,
@@ -34,9 +36,12 @@ __all__ = [
     'ComputeMethod',
     'CurvatureCapture',
     'DistributedStrategy',
+    'HealthConfig',
+    'HealthState',
     'KFACPreconditioner',
     'KFACState',
     'Registry',
+    'health',
     'TrainState',
     'Trainer',
     'checkpoint',

@@ -120,14 +120,21 @@ def _factors_from_saved(
 
 
 def durable_state(state: Any) -> dict[str, Any]:
-    """The persistent slice of a K-FAC state: step + factors only.
+    """The persistent slice of a K-FAC state: step + factors, plus the
+    numerical-health counters when the sentinel is enabled.
 
     Works for the NamedTuple states of the dense/KAISA engines and the
-    dict state of :class:`kfac_tpu.parallel.PipelineKFAC`.
+    dict state of :class:`kfac_tpu.parallel.PipelineKFAC`. The health
+    counters are stored as a plain field dict of per-layer scalars —
+    layout-independent, so they also survive cross-layout migration.
     """
     if isinstance(state, dict):
         return {'step': state['step'], 'a': state['a'], 'g': state['g']}
-    return {'step': state.step, 'a': state.a, 'g': state.g}
+    out = {'step': state.step, 'a': state.a, 'g': state.g}
+    health = getattr(state, 'health', None)
+    if health is not None:
+        out['health'] = health._asdict()
+    return out
 
 
 def _with_durable(state: Any, loaded: dict[str, Any]) -> Any:
@@ -136,9 +143,74 @@ def _with_durable(state: Any, loaded: dict[str, Any]) -> Any:
             **state,
             'step': loaded['step'], 'a': loaded['a'], 'g': loaded['g'],
         }
-    return state._replace(
+    state = state._replace(
         step=loaded['step'], a=loaded['a'], g=loaded['g']
     )
+    if 'health' in loaded and getattr(state, 'health', None) is not None:
+        state = state._replace(health=_health_from_saved(loaded['health']))
+    return state
+
+
+def _health_from_saved(saved: Any) -> Any:
+    """Rebuild a :class:`kfac_tpu.health.HealthState` from its saved field
+    dict (or pass one through that orbax already restored structured)."""
+    from kfac_tpu import health as health_lib
+
+    if isinstance(saved, health_lib.HealthState):
+        return saved
+    return health_lib.HealthState(
+        skipped_steps=saved['skipped_steps'],
+        damping_mult=dict(saved['damping_mult']),
+        quarantined=dict(saved['quarantined']),
+        bad_inv=dict(saved['bad_inv']),
+        quarantine_events=dict(saved['quarantine_events']),
+    )
+
+
+def _validate_restored_factors(path: str, engine: Any, state: Any) -> None:
+    """Reject corrupt checkpoints up front with a layer-named error.
+
+    A factor that went to disk with inf/NaN (e.g. saved before the health
+    sentinel existed, or written by a run that diverged) would otherwise
+    surface steps later as an unexplained eigh failure; a wrong per-layer
+    shape (model width changed between save and restore) would silently
+    precondition with garbage. Both checks run on the per-layer true-dim
+    view, so the error names the layer, not a stacked bucket slot.
+    """
+    import numpy as np
+
+    if not hasattr(engine, 'extract_factors'):
+        return
+    # pipeline states stack a stage axis onto the per-layer factors; only
+    # the finiteness check applies there
+    check_shapes = not isinstance(state, dict)
+    reg = getattr(engine, 'registry', None)
+    for name, fg in engine.extract_factors(state).items():
+        helper = reg.layers.get(name) if reg is not None else None
+        for side in ('a', 'g'):
+            arr = np.asarray(jax.device_get(fg[side]))
+            if not np.isfinite(arr).all():
+                bad = int(arr.size - np.isfinite(arr).sum())
+                raise ValueError(
+                    f'checkpoint at {path!r}: restored {side.upper()} '
+                    f'factor for layer {name!r} contains {bad} non-finite '
+                    'values — the checkpoint is corrupt (saved from a '
+                    'diverged run?); restore a different one or reinitialize '
+                    'the preconditioner state.'
+                )
+            if helper is not None and check_shapes:
+                exp = tuple(
+                    helper.a_factor_shape if side == 'a'
+                    else helper.g_factor_shape
+                )
+                if tuple(arr.shape) != exp:
+                    raise ValueError(
+                        f'checkpoint at {path!r}: restored {side.upper()} '
+                        f'factor for layer {name!r} has shape '
+                        f'{tuple(arr.shape)} but the engine expects {exp} — '
+                        'the model architecture changed between save and '
+                        'restore.'
+                    )
 
 
 def save(
@@ -270,19 +342,82 @@ def restore(
     try:
         payload = ckptr.restore(path, target=template)
     except (ValueError, KeyError) as exc:
-        raise ValueError(
-            f'checkpoint at {path!r} does not match the engine state '
-            'layout. For DistributedKFAC the stacked bucket keys/shapes '
-            'depend on the config (notably bucket_granularity and '
-            'colocate_factors): restore with the SAME values the '
-            'checkpoint was saved under — or write checkpoints with '
-            'save(..., engine=engine) so restore can diagnose and migrate '
-            f'layout changes. Original error: {exc}'
-        ) from exc
+        payload = _retry_health_mismatch(
+            ckptr, path, template, template_state, engine, exc
+        )
     state = _with_durable(template_state, payload['kfac'])
+    _validate_restored_factors(path, engine, state)
+    loaded_health = (
+        getattr(state, 'health', None)
+        if not isinstance(state, dict)
+        else None
+    )
     state = engine.rematerialize(state)
+    if loaded_health is not None:
+        # rematerialize ticks the degradation counters from ITS verdicts on
+        # the freshly recomputed decompositions; the checkpoint's counters
+        # are the durable truth for a resumed run, so they win
+        state = state._replace(health=loaded_health)
     extra = {k: v for k, v in payload.items() if k != 'kfac'}
     return state, extra
+
+
+def _retry_health_mismatch(
+    ckptr: Any,
+    path: str,
+    template: dict[str, Any],
+    template_state: Any,
+    engine: Any,
+    exc: Exception,
+) -> dict[str, Any]:
+    """Structure-mismatch fallback: tolerate health-presence drift.
+
+    A checkpoint written without health counters must restore into a
+    health-enabled engine (counters start fresh), and one written WITH
+    them must restore into a health-disabled engine (counters dropped) —
+    toggling the sentinel between runs is configuration, not a layout
+    change. Anything else re-raises the layout diagnosis."""
+    kfac_t = template['kfac']
+    retried = None
+    if 'health' in kfac_t:
+        retried = {
+            **template,
+            'kfac': {k: v for k, v in kfac_t.items() if k != 'health'},
+        }
+    else:
+        reg = getattr(engine, 'registry', None)
+        if reg is not None and not isinstance(template_state, dict):
+            from kfac_tpu import health as health_lib
+
+            retried = {
+                **template,
+                'kfac': {
+                    **kfac_t,
+                    'health': health_lib.init_health(
+                        reg.names()
+                    )._asdict(),
+                },
+            }
+    if retried is not None:
+        try:
+            payload = ckptr.restore(path, target=retried)
+        except (ValueError, KeyError):
+            payload = None
+        if payload is not None:
+            # either direction resolves to "no health in the loaded
+            # payload": a sentinel-less checkpoint keeps init()'s fresh
+            # counters; a sentinel-less engine drops the saved ones
+            payload['kfac'].pop('health', None)
+            return payload
+    raise ValueError(
+        f'checkpoint at {path!r} does not match the engine state '
+        'layout. For DistributedKFAC the stacked bucket keys/shapes '
+        'depend on the config (notably bucket_granularity and '
+        'colocate_factors): restore with the SAME values the '
+        'checkpoint was saved under — or write checkpoints with '
+        'save(..., engine=engine) so restore can diagnose and migrate '
+        f'layout changes. Original error: {exc}'
+    ) from exc
 
 
 def _migrate_restore(
@@ -369,6 +504,18 @@ def _migrate_restore(
     else:
         state = state._replace(step=step)
     state = engine.rematerialize(state)
+    if (
+        not isinstance(state, dict)
+        and getattr(template_state, 'health', None) is not None
+        and isinstance(raw.get('kfac'), dict)
+        and 'health' in raw['kfac']
+    ):
+        # per-layer health counters are layout-independent (keyed by layer
+        # name, scalar values) — they migrate verbatim
+        saved_h = jax.tree_util.tree_map(
+            jnp.asarray, raw['kfac']['health']
+        )
+        state = state._replace(health=_health_from_saved(saved_h))
 
     if extra_template:
         # The target-less restore flattens custom pytree nodes (optax

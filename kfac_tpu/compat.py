@@ -1,0 +1,49 @@
+"""JAX version compatibility shims.
+
+The framework targets the current JAX API surface; older installs get
+adapters here, loaded from ``kfac_tpu/__init__.py`` before anything else
+so every module (and the test suite, which imports ``kfac_tpu``) sees a
+uniform API.
+
+``jax.shard_map``: promoted out of ``jax.experimental.shard_map`` with two
+renames — ``axis_names`` (the manual axes) replaced the complementary
+``auto`` frozenset, and ``check_vma`` replaced ``check_rep``. On installs
+without the top-level binding we install an adapter that accepts the new
+spelling and translates.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, 'shard_map'):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(
+        f: Any,
+        mesh: Any = None,
+        in_specs: Any = None,
+        out_specs: Any = None,
+        axis_names: Any = None,
+        check_vma: bool | None = None,
+        **kwargs: Any,
+    ):
+        if axis_names is not None:
+            kwargs['auto'] = frozenset(mesh.axis_names) - frozenset(
+                axis_names
+            )
+        if check_vma is not None:
+            kwargs['check_rep'] = check_vma
+        return _legacy(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+
+    jax.shard_map = shard_map
+
+
+_install_shard_map()

@@ -1,0 +1,349 @@
+"""Numerical-health sentinel: skip-step, factor quarantine, degradation.
+
+K-FAC is the most numerically fragile part of the stack: factors are
+EMA'd running covariances, inverted in fp32, and a single non-finite
+capture poisons ``A``/``G`` for every subsequent step — the reference
+implementation simply crashes or silently diverges in this regime
+(kfac/layers/eigen.py). Large-scale training reports (OPT/PaLM-style
+logs) consistently cite skip-step + escalated damping as the load-bearing
+recovery mechanisms for (near-)second-order optimizers. This module makes
+a transient loss spike, a pathological decomposition, or one bad
+microbatch degrade a *layer*, not the *run*:
+
+1. **Skip-step** — a cheap fused finiteness reduction over loss + grads
+   gates the whole update (params, optimizer, factors) via ``lax.cond``
+   inside the jitted step, incrementing :attr:`HealthState.skipped_steps`
+   instead of applying a poisoned update. Wired in
+   :class:`kfac_tpu.training.Trainer` (all execution paths: ``step``,
+   ``scan_steps``, and the gradient-accumulation family).
+2. **Factor quarantine** — per layer, a factor update whose EMA'd result
+   is non-finite or whose Gershgorin condition bound exceeds
+   :attr:`HealthConfig.quarantine_threshold` is rolled back to the
+   previous factor, and the layer's damping multiplier escalates
+   (decaying back toward 1.0 on healthy updates). Wired in both engines'
+   ``update_factors``.
+3. **Graceful degradation** — after :attr:`HealthConfig.degrade_after`
+   consecutive quarantined inversions (the inverse refresh ran from a
+   quarantined factor, or its own output was non-finite), the layer's
+   preconditioner is bypassed — its update is the raw gradient direction
+   — until the health counter recovers. The run continues as
+   partially-first-order rather than dying. Wired in both engines'
+   ``update_inverses`` / ``precondition``.
+
+All health state lives in :class:`HealthState` as plain scalar arrays
+(jit-, scan-, and checkpoint-compatible; per-layer scalars are
+layout-independent, so they ride identically in the dense
+:class:`~kfac_tpu.preconditioner.KFACState` and the stacked
+:class:`~kfac_tpu.parallel.kaisa.DistKFACState`, under either stat
+transport). Counters are surfaced host-side through
+:func:`kfac_tpu.tracing.health_counters` and rate-limited warnings
+through :func:`kfac_tpu.warnings.warn_health_event`.
+
+Deterministic fault injection for all three mechanisms lives in
+``testing/faults.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kfac_tpu import warnings as kfac_warnings
+from kfac_tpu.ops import factors as factors_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Knobs of the numerical-health sentinel.
+
+    Pass an instance as ``KFACPreconditioner(health=...)`` (or
+    ``health=True`` for these defaults); ``health=None`` (the default)
+    disables all health machinery — zero state, zero per-step cost,
+    reference semantics (a non-finite capture crashes or silently
+    diverges the run).
+
+    Args:
+        skip_nonfinite: gate the whole train-step update (params,
+            optimizer state, factors) on a fused finiteness check of
+            loss + gradients. The reference's closest analogue is the
+            AMP grad-scaler skip (torch.cuda.amp); here it guards every
+            precision mode.
+        quarantine_threshold: a factor update whose Gershgorin condition
+            bound (``ops/factors.gershgorin_condition_bound`` at the
+            layer's effective damping) exceeds this is quarantined even
+            when finite — its fp32 inverse could not be trusted anyway
+            (forward error ``O(kappa * eps)``). ``None`` disables the
+            conditioning check (finiteness-only quarantine).
+        damping_escalation: per quarantine event, the layer's damping
+            multiplier is multiplied by this (>1). Escalated damping is
+            the standard recovery lever: it pulls the preconditioner
+            toward (scaled) SGD for exactly the layer that misbehaved.
+        damping_decay: on each healthy factor update the multiplier
+            decays by this (in (0, 1)), floored at 1.0 — transient
+            events anneal back to nominal damping.
+        max_damping_mult: cap on the multiplier, bounding how far a
+            persistently bad layer can escalate.
+        degrade_after: consecutive quarantined inversions after which the
+            layer's preconditioner is bypassed (identity — the raw
+            gradient direction). Recovery is hysteretic: each healthy
+            inversion decrements the counter, so a layer degraded at K
+            needs healthy inversions to climb back below K.
+        warn: emit rate-limited host-side warnings (via
+            :func:`check_and_warn`) from the Trainer's eager paths the
+            first time a layer is quarantined or degraded. Reading the
+            counters synchronizes with the device, so latency-critical
+            loops (or fully compiled ``scan_steps`` loops, which never
+            return to the host mid-run) should leave this to an explicit
+            ``Trainer.check_health`` call at their logging cadence.
+    """
+
+    skip_nonfinite: bool = True
+    quarantine_threshold: float | None = 1e8
+    damping_escalation: float = 10.0
+    damping_decay: float = 0.5
+    max_damping_mult: float = 1e6
+    degrade_after: int = 3
+    warn: bool = True
+
+    def __post_init__(self) -> None:
+        if self.damping_escalation <= 1.0:
+            raise ValueError(
+                f'damping_escalation must be > 1, got {self.damping_escalation}'
+            )
+        if not 0.0 < self.damping_decay < 1.0:
+            raise ValueError(
+                f'damping_decay must be in (0, 1), got {self.damping_decay}'
+            )
+        if self.max_damping_mult < self.damping_escalation:
+            raise ValueError(
+                'max_damping_mult must be >= damping_escalation, got '
+                f'{self.max_damping_mult}'
+            )
+        if self.degrade_after < 1:
+            raise ValueError(
+                f'degrade_after must be >= 1, got {self.degrade_after}'
+            )
+        if (
+            self.quarantine_threshold is not None
+            and self.quarantine_threshold <= 1.0
+        ):
+            raise ValueError(
+                'quarantine_threshold is a condition-number bound and must '
+                f'be > 1 (or None to disable), got {self.quarantine_threshold}'
+            )
+
+
+class HealthState(NamedTuple):
+    """Per-run + per-layer health counters, all plain scalar arrays.
+
+    ``skipped_steps``: whole-batch updates dropped by the skip-step gate.
+    ``damping_mult``: per-layer damping escalation multiplier (>= 1).
+    ``quarantined``: per-layer CONSECUTIVE quarantined factor updates
+    (0 = the layer's resident factor is its own latest update).
+    ``bad_inv``: per-layer consecutive quarantined inversions — the
+    degradation counter (clamped at ``2 * degrade_after`` so recovery
+    from a long outage is bounded).
+    ``quarantine_events``: per-layer CUMULATIVE quarantine events, for
+    tracing/warnings (monotone; never reset).
+    """
+
+    skipped_steps: jax.Array
+    damping_mult: dict[str, jax.Array]
+    quarantined: dict[str, jax.Array]
+    bad_inv: dict[str, jax.Array]
+    quarantine_events: dict[str, jax.Array]
+
+
+def init_health(names: Iterable[str]) -> HealthState:
+    """Fresh (healthy) counters for the given registered layer names."""
+    names = list(names)
+    return HealthState(
+        skipped_steps=jnp.zeros((), jnp.int32),
+        damping_mult={n: jnp.ones((), jnp.float32) for n in names},
+        quarantined={n: jnp.zeros((), jnp.int32) for n in names},
+        bad_inv={n: jnp.zeros((), jnp.int32) for n in names},
+        quarantine_events={n: jnp.zeros((), jnp.int32) for n in names},
+    )
+
+
+# ----------------------------------------------------------------- predicates
+
+
+def all_finite(*trees: Any) -> jax.Array:
+    """Scalar bool: every inexact leaf of every tree is free of inf/nan.
+
+    The skip-step sentinel: one ``isfinite().all()`` per leaf, combined
+    by a single stacked reduction — XLA fuses this into the backward pass
+    it already ran, so the gate costs one elementwise sweep, no extra
+    host sync (contrast the reference's grad-scaler ``.item()`` check).
+    """
+    flags = []
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            x = jnp.asarray(leaf)
+            if jnp.issubdtype(x.dtype, jnp.inexact):
+                flags.append(jnp.isfinite(x).all())
+    if not flags:
+        return jnp.asarray(True)
+    return jnp.stack(flags).all()
+
+
+def factor_ok(
+    candidate: jax.Array,
+    damping: jax.Array | float,
+    threshold: float | None,
+) -> jax.Array:
+    """Per-factor health verdict for a ``(..., d, d)`` stack -> ``(...,)``.
+
+    A factor update is healthy when it is finite AND (if ``threshold``)
+    its Gershgorin condition bound at the layer's effective damping stays
+    below the quarantine threshold. A NaN factor yields a NaN bound whose
+    comparison is False, so both legs fail closed.
+    """
+    ok = jnp.isfinite(candidate).all(axis=(-2, -1))
+    if threshold is not None:
+        bound = factors_lib.gershgorin_condition_bound(candidate, damping)
+        ok = ok & (bound <= threshold)
+    return ok
+
+
+# ---------------------------------------------------------------- transitions
+# All transition helpers broadcast: scalars for the dense per-layer engine,
+# (L,) slot vectors for the stacked KAISA engine — one implementation of the
+# state machine, two layouts.
+
+
+def quarantine_update(
+    cfg: HealthConfig,
+    ok: jax.Array,
+    mult: jax.Array,
+    quarantined: jax.Array,
+    events: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Factor-update-time transition: escalate on quarantine, decay on
+    health. Returns ``(damping_mult, quarantined, quarantine_events)``."""
+    bad = ~ok
+    new_mult = jnp.where(
+        bad,
+        jnp.minimum(mult * cfg.damping_escalation, cfg.max_damping_mult),
+        jnp.maximum(1.0, mult * cfg.damping_decay),
+    )
+    new_quarantined = jnp.where(bad, quarantined + 1, 0)
+    new_events = events + bad.astype(events.dtype)
+    return new_mult, new_quarantined, new_events
+
+
+def inversion_update(
+    cfg: HealthConfig,
+    ok: jax.Array,
+    quarantined: jax.Array,
+    bad_inv: jax.Array,
+) -> jax.Array:
+    """Inversion-time transition of the degradation counter.
+
+    An inversion is *quarantined* when it ran from a quarantined (stale,
+    rolled-back) factor or its own output was non-finite. The counter is
+    clamped at ``2 * degrade_after`` so a layer broken for thousands of
+    steps still recovers after ``degrade_after + 1`` healthy inversions
+    instead of paying back the whole outage.
+    """
+    bad = (~ok) | (quarantined > 0)
+    cap = 2 * cfg.degrade_after
+    return jnp.where(
+        bad,
+        jnp.minimum(bad_inv + 1, cap),
+        jnp.maximum(bad_inv - 1, 0),
+    )
+
+
+def is_degraded(cfg: HealthConfig, bad_inv: jax.Array) -> jax.Array:
+    """Bool (scalar or (L,)): the layer's preconditioner is bypassed."""
+    return bad_inv >= cfg.degrade_after
+
+
+def mark_skipped(state: Any) -> Any:
+    """Skip-step branch: advance the step clock, count the skip, change
+    NOTHING else (params/optimizer are untouched by the caller's cond).
+
+    The step counter advances so hyperparameter schedules and the
+    factor/inverse cadence stay aligned with the host-side dispatch
+    mirror — the *update* is skipped, not the clock.
+    """
+    h = state.health
+    return state._replace(
+        step=state.step + 1,
+        health=h._replace(skipped_steps=h.skipped_steps + 1),
+    )
+
+
+# ------------------------------------------------------------- host utilities
+
+
+def summary(cfg: HealthConfig, health: HealthState) -> dict[str, Any]:
+    """Host-side snapshot: counters + derived per-layer status strings.
+
+    Synchronizes with the device (one small transfer). Layers are
+    ``'ok'``, ``'quarantined'`` (living on a rolled-back factor), or
+    ``'degraded'`` (preconditioner bypassed).
+    """
+    vals = jax.device_get(health._asdict())
+    layers = {}
+    for n in vals['damping_mult']:
+        bad_inv = int(vals['bad_inv'][n])
+        if bad_inv >= cfg.degrade_after:
+            status = 'degraded'
+        elif int(vals['quarantined'][n]) > 0:
+            status = 'quarantined'
+        else:
+            status = 'ok'
+        layers[n] = {
+            'status': status,
+            'damping_mult': float(vals['damping_mult'][n]),
+            'quarantined': int(vals['quarantined'][n]),
+            'bad_inv': bad_inv,
+            'quarantine_events': int(vals['quarantine_events'][n]),
+        }
+    return {
+        'skipped_steps': int(vals['skipped_steps']),
+        'layers': layers,
+    }
+
+
+def check_and_warn(
+    cfg: HealthConfig,
+    health: HealthState,
+    step: int | None = None,
+) -> dict[str, Any]:
+    """Scan counters and emit the rate-limited first-occurrence warnings.
+
+    Emits one :class:`kfac_tpu.warnings.NumericalHealthWarning` per
+    (layer, cause) for the life of the process — the first time a layer
+    shows a quarantine event and the first time it crosses into
+    degradation — instead of spamming every step (see
+    ``kfac_tpu.warnings.warn_health_event``). Returns the
+    :func:`summary` it scanned, so logging callers pay the device sync
+    once.
+    """
+    snap = summary(cfg, health)
+    for name, info in snap['layers'].items():
+        if info['quarantine_events'] > 0:
+            kfac_warnings.warn_health_event(
+                name, step, 'quarantined',
+                detail=(
+                    f"{info['quarantine_events']} quarantine event(s), "
+                    f"damping_mult={info['damping_mult']:g}"
+                ),
+            )
+        if info['status'] == 'degraded':
+            kfac_warnings.warn_health_event(
+                name, step, 'degraded',
+                detail=(
+                    f"{info['bad_inv']} consecutive quarantined "
+                    f'inversions (>= degrade_after={cfg.degrade_after}); '
+                    'preconditioner bypassed, raw gradient in use'
+                ),
+            )
+    return snap

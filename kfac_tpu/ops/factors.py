@@ -170,11 +170,26 @@ def gershgorin_condition_bound(
     (``log2(kappa) + 5`` iterations reach the fp32 floor) or to flag factors
     whose fp32 inverse (by ANY solver — Cholesky's backward-stable solve
     also has forward error ``O(kappa * eps)``) cannot be trusted.
+
+    Batched: a ``(..., d, d)`` stack yields per-matrix bounds ``(...,)``;
+    ``damping`` broadcasts (scalar, or per-matrix ``(...,)`` for per-layer
+    escalated damping). At ``damping == 0`` the eigenvalue floor vanishes
+    and the true condition number of a PSD factor may genuinely be
+    infinite, but an ``inf``/``0/0`` here poisons every downstream
+    comparison (``inf * 0``, health thresholds), so the denominator is
+    floored at fp32 ``tiny`` and the quotient is capped at fp32 ``max``
+    (``lam_max / tiny`` itself overflows to inf for any ``lam_max``
+    above ~4) — the bound saturates at a huge-but-finite value that any
+    sane threshold still flags. A NaN factor still propagates NaN (fails
+    closed in ``health.factor_ok``'s threshold compare).
     """
     f = factor.astype(jnp.float32)
-    m = f + damping * jnp.eye(f.shape[-1], dtype=jnp.float32)
-    lam_max = jnp.max(jnp.sum(jnp.abs(m), axis=-1))
-    return lam_max / damping
+    d = jnp.asarray(damping, jnp.float32)
+    eye = jnp.eye(f.shape[-1], dtype=jnp.float32)
+    m = f + d[..., None, None] * eye
+    lam_max = jnp.max(jnp.sum(jnp.abs(m), axis=-1), axis=-1)
+    fi = jnp.finfo(jnp.float32)
+    return jnp.minimum(lam_max / jnp.maximum(d, fi.tiny), fi.max)
 
 
 class NewtonSchulzInfo(NamedTuple):
@@ -423,25 +438,31 @@ def batched_damped_inverse_auto(
     batched Cholesky only when some slot's residual exceeds
     ``NS_FALLBACK_RESIDUAL``, then selects per slot. The common
     (well-conditioned) case costs pure MXU matmuls.
+
+    ``damping`` may be a scalar or a per-slot ``(n,)`` vector (per-layer
+    escalated damping under factor quarantine) — broadcast into the vmap.
     """
+    dmp = jnp.broadcast_to(
+        jnp.asarray(damping, jnp.float32), stack.shape[:-2]
+    )
     if x0 is None:
         infos = jax.vmap(
-            lambda m: newton_schulz_inverse_info(
-                m, damping, jnp.float32, max_iters=iters
+            lambda m, dm: newton_schulz_inverse_info(
+                m, dm, jnp.float32, max_iters=iters
             )
-        )(stack)
+        )(stack, dmp)
     else:
         infos = jax.vmap(
-            lambda m, w: newton_schulz_inverse_info(
-                m, damping, jnp.float32, max_iters=iters, x0=w
+            lambda m, dm, w: newton_schulz_inverse_info(
+                m, dm, jnp.float32, max_iters=iters, x0=w
             )
-        )(stack, x0)
+        )(stack, dmp, x0)
     bad = ~(infos.residual <= NS_FALLBACK_RESIDUAL)  # (n,); NaN -> bad
 
     def fallback(_):
         chol = jax.vmap(
-            lambda m: compute_inverse(m, damping, jnp.float32)
-        )(stack)
+            lambda m, dm: compute_inverse(m, dm, jnp.float32)
+        )(stack, dmp)
         return jnp.where(bad[:, None, None], chol, infos.inverse)
 
     out = jax.lax.cond(jnp.any(bad), fallback, lambda _: infos.inverse, None)

@@ -143,13 +143,23 @@ def _spmd_partition(mesh, arg_shapes, result_shape):
     return mesh, lower, NamedSharding(mesh, P()), arg_shardings
 
 
-sym_cov_spmd.def_partition(
-    infer_sharding_from_operands=_spmd_infer,
-    partition=_spmd_partition,
-    # fresh output factors: C's dims never inherit the (gathered) feature
-    # sharding of d1; the contracted row factor n drives the psum
-    sharding_rule='n d1 -> d2 d3',
-)
+try:
+    sym_cov_spmd.def_partition(
+        infer_sharding_from_operands=_spmd_infer,
+        partition=_spmd_partition,
+        # fresh output factors: C's dims never inherit the (gathered)
+        # feature sharding of d1; the contracted row factor n drives the
+        # psum
+        sharding_rule='n d1 -> d2 d3',
+    )
+except TypeError:
+    # older custom_partitioning without shardy rule support: the callback
+    # pair fully determines the GSPMD partitioning, the einsum-style rule
+    # only adds shardy-propagation hints
+    sym_cov_spmd.def_partition(
+        infer_sharding_from_operands=_spmd_infer,
+        partition=_spmd_partition,
+    )
 
 
 def use_pallas_for(d: int, dtype) -> bool:

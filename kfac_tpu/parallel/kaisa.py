@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kfac_tpu import assignment as assignment_lib
 from kfac_tpu import enums
+from kfac_tpu import health as health_lib
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
 from kfac_tpu.ops import factors as factors_lib
@@ -187,6 +188,11 @@ class DistKFACState(NamedTuple):
     :meth:`DistributedKFAC.inverse_residuals` so quality monitoring
     measures the inverse against the system it actually solved. Derived
     state: recomputed with the decompositions, never checkpointed.
+
+    ``health``: :class:`kfac_tpu.health.HealthState` counters when the
+    numerical-health sentinel is enabled, else ``None``. Per-layer scalars
+    (replicated — layout-independent, so the same counters ride the dense
+    and stacked states and survive cross-layout checkpoint migration).
     """
 
     step: jax.Array
@@ -200,6 +206,7 @@ class DistKFACState(NamedTuple):
     a_inv: dict[str, jax.Array]
     g_inv: dict[str, jax.Array]
     inv_damping: jax.Array
+    health: Any = None
 
 
 @dataclasses.dataclass
@@ -328,6 +335,17 @@ class DistributedKFAC:
             return {sb.key: sh for sb in self.g_store}
 
         eigen = self._eigen
+        if self.config.health is not None:
+            names = list(self.registry.layers)
+            health_sh = health_lib.HealthState(
+                skipped_steps=rep,
+                damping_mult={n: rep for n in names},
+                quarantined={n: rep for n in names},
+                bad_inv={n: rep for n in names},
+                quarantine_events={n: rep for n in names},
+            )
+        else:
+            health_sh = None
         return DistKFACState(
             step=rep,
             a=adict(fac),
@@ -340,6 +358,7 @@ class DistributedKFAC:
             a_inv={} if eigen else adict(dec),
             g_inv={} if eigen else gdict(dec),
             inv_damping=rep,
+            health=health_sh,
         )
 
     # ----------------------------------------------------------------- init
@@ -392,6 +411,10 @@ class DistributedKFAC:
                 inv_damping=jnp.asarray(
                     _resolve(cfg.damping, jnp.asarray(0, jnp.int32)),
                     jnp.float32,
+                ),
+                health=(
+                    health_lib.init_health(self.registry.layers)
+                    if cfg.health is not None else None
                 ),
             )
 
@@ -495,6 +518,37 @@ class DistributedKFAC:
 
         return stack_side(self.a_store, rows_a), stack_side(self.g_store, rows_g)
 
+    # --------------------------------------------------------------- health
+
+    def _slot_mults(
+        self, health, layers: tuple[str, ...], padded: int
+    ) -> jax.Array:
+        """(L,) per-slot damping multipliers for a stack's layers (padding
+        slots at 1.0). Assembled by update-slice, not jnp.stack: GSPMD
+        mispartitions stacks of replicated scalars on fractional
+        grad-worker meshes (see the gstack note in ``precondition``)."""
+        out = jnp.ones((padded,), jnp.float32)
+        for i, n in enumerate(layers):
+            out = out.at[i].set(health.damping_mult[n])
+        return out
+
+    def _slot_mask(
+        self,
+        flags: dict[str, jax.Array],
+        layers: tuple[str, ...],
+        padded: int,
+    ) -> jax.Array | None:
+        """(L,) bool from per-layer flags; layers without a flag (and
+        padding slots) are False. None when no slot carries a flag.
+        Update-slice assembly for the same reason as ``_slot_mults``."""
+        if not any(n in flags for n in layers):
+            return None
+        out = jnp.zeros((padded,), bool)
+        for i, n in enumerate(layers):
+            if n in flags:
+                out = out.at[i].set(flags[n])
+        return out
+
     # ------------------------------------------------------- factor updates
 
     def update_factors(
@@ -542,9 +596,69 @@ class DistributedKFAC:
                     out[sb.key] = av * side_state[sb.key] + (1 - av) * s
             return out
 
+        new_a = ema(self.a_store, state.a, a_stacks)
+        new_g = ema(self.g_store, state.g, g_stacks)
+        if self.config.health is None:
+            return state._replace(a=new_a, g=new_g)
+
+        # factor quarantine, stacked form: one batched verdict per storage
+        # bucket (finite + Gershgorin at each slot's effective damping),
+        # combined per LAYER across its A and G slots so both factors roll
+        # back together — same semantics as the dense engine's per-layer
+        # loop (kfac_tpu/preconditioner.py:update_factors). Layers absent
+        # from this capture get no verdict (their stacked stat is their own
+        # state value — the EMA left them unchanged).
+        hc = self.config.health
+        h = state.health
+        damping = _resolve(self.config.damping, state.step)
+        updated = set(stats.a) | set(stats.g)
+
+        def verdicts(store, stacks):
+            return {
+                sb.key: health_lib.factor_ok(
+                    stacks[sb.key],
+                    damping * self._slot_mults(h, sb.layers, sb.padded),
+                    hc.quarantine_threshold,
+                )
+                for sb in store
+            }
+
+        ok_a = verdicts(self.a_store, new_a)
+        ok_g = verdicts(self.g_store, new_g)
+        ok: dict[str, jax.Array] = {}
+        for n in self.registry.layers:
+            if n not in updated:
+                continue
+            ak, ai = self._a_slot[n]
+            gk, gi = self._g_slot[n]
+            ok[n] = ok_a[ak][ai] & ok_g[gk][gi]
+        roll = {n: ~v for n, v in ok.items()}
+
+        def rollback(store, old, new):
+            out = {}
+            for sb in store:
+                mask = self._slot_mask(roll, sb.layers, sb.padded)
+                out[sb.key] = (
+                    new[sb.key] if mask is None
+                    else jnp.where(mask[:, None, None], old[sb.key], new[sb.key])
+                )
+            return out
+
+        mult = dict(h.damping_mult)
+        quarantined = dict(h.quarantined)
+        events = dict(h.quarantine_events)
+        for n, okn in ok.items():
+            mult[n], quarantined[n], events[n] = health_lib.quarantine_update(
+                hc, okn, h.damping_mult[n], h.quarantined[n],
+                h.quarantine_events[n],
+            )
         return state._replace(
-            a=ema(self.a_store, state.a, a_stacks),
-            g=ema(self.g_store, state.g, g_stacks),
+            a=rollback(self.a_store, state.a, new_a),
+            g=rollback(self.g_store, state.g, new_g),
+            health=h._replace(
+                damping_mult=mult, quarantined=quarantined,
+                quarantine_events=events,
+            ),
         )
 
     # ------------------------------------------------------------- inverses
@@ -577,24 +691,30 @@ class DistributedKFAC:
     ) -> jax.Array:
         """Batched sharded damped inverse; ``prev`` (the resident inverse
         stack) warm-starts Newton-Schulz per slot — safeguarded inside
-        the solver, so a fresh state's zero inverses cold-start."""
+        the solver, so a fresh state's zero inverses cold-start.
+        ``damping`` may be a scalar or a per-slot (L,) vector (per-layer
+        escalated damping under factor quarantine) — the vector rides the
+        shard_map with the same slot sharding as the stack."""
+        dmp = jnp.broadcast_to(
+            jnp.asarray(damping, jnp.float32), stack.shape[:1]
+        )
 
-        def local(block, prev_block):
+        def local(block, prev_block, dmp_block):
             if self.config.inverse_solver == 'auto':
                 # one scalar cond per device-local block: Cholesky runs
                 # at runtime only when some slot's NS residual fails —
                 # not the vmapped per-slot cond that lowers to a
                 # pay-both-branches select
                 return factors_lib.batched_damped_inverse_auto(
-                    block, damping, jnp.float32,
+                    block, dmp_block, jnp.float32,
                     self.config.newton_schulz_iters, x0=prev_block,
                 )
             return jax.vmap(
-                lambda m, w: factors_lib.damped_inverse(
-                    m, damping, jnp.float32, self.config.inverse_solver,
+                lambda m, w, dm: factors_lib.damped_inverse(
+                    m, dm, jnp.float32, self.config.inverse_solver,
                     self.config.newton_schulz_iters, x0=w,
                 )
-            )(block, prev_block)
+            )(block, prev_block, dmp_block)
 
         if prev is None:
             prev = jnp.zeros_like(stack)
@@ -603,14 +723,31 @@ class DistributedKFAC:
         # to a bf16 factor dtype would inflate the warm residual by
         # eps_bf16 * kappa and reject the warm start exactly in the
         # high-kappa regime where it saves the most
+        # check_vma=False: the NS solver's convergence while_loop has no
+        # replication rule on some installs; the body is forward-only
+        # (never differentiated), so the check buys nothing here.
         return jax.shard_map(
-            local, mesh=self.mesh, in_specs=(spec, spec), out_specs=spec
-        )(stack, prev)
+            local, mesh=self.mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False,
+        )(stack, prev, dmp)
 
     def update_inverses(self, state: DistKFACState) -> DistKFACState:
         cfg = self.config
+        hc = cfg.health
+        h = state.health
         damping = _resolve(cfg.damping, state.step)
         dec = NamedSharding(self.mesh, self._decomp_spec())
+        # per-slot verdicts on this refresh's outputs, per storage bucket —
+        # combined per layer below into the degradation counter
+        ok_a_slots: dict[str, jax.Array] = {}
+        ok_g_slots: dict[str, jax.Array] = {}
+        ok_fused: dict[str, jax.Array] = {}
+
+        def slot_damping(layers, padded):
+            if hc is None:
+                return damping
+            return damping * self._slot_mults(h, layers, padded)
+
         if self._eigen:
             qa, qg, da, dg, dgda = {}, {}, {}, {}, {}
             # Reshard to the strategy's resident layout: XLA inserts the
@@ -620,63 +757,106 @@ class DistributedKFAC:
             # stacks — a layer's two eigendecompositions land on whichever
             # devices own their side's slots.
             d_a_by_key, d_g_by_key = {}, {}
-            for sb in self.a_store:
-                q_a, d_a = self._sharded_eigh(state.a[sb.key])
-                qa[sb.key] = jax.lax.with_sharding_constraint(
-                    q_a.astype(cfg.inv_dtype), dec
-                )
-                d_a_by_key[sb.key] = d_a
-                if not self._prediv:
-                    da[sb.key] = jax.lax.with_sharding_constraint(
-                        d_a.astype(cfg.inv_dtype), dec
-                    )
-            for sb in self.g_store:
-                q_g, d_g = self._sharded_eigh(state.g[sb.key])
-                qg[sb.key] = jax.lax.with_sharding_constraint(
-                    q_g.astype(cfg.inv_dtype), dec
-                )
-                d_g_by_key[sb.key] = d_g
-                if not self._prediv:
-                    dg[sb.key] = jax.lax.with_sharding_constraint(
-                        d_g.astype(cfg.inv_dtype), dec
-                    )
+
+            def side(store, side_state, prev_q, prev_d, q_out, d_out,
+                     d_by_key, ok_slots):
+                for sb in store:
+                    q_, d_ = self._sharded_eigh(side_state[sb.key])
+                    qc = q_.astype(cfg.inv_dtype)
+                    if hc is not None:
+                        okv = jnp.isfinite(q_).all(axis=(-2, -1)) & jnp.isfinite(
+                            d_
+                        ).all(axis=-1)
+                        ok_slots[sb.key] = okv
+                        # non-finite decomposition: keep the previous one
+                        qc = jnp.where(okv[:, None, None], qc, prev_q[sb.key])
+                    q_out[sb.key] = jax.lax.with_sharding_constraint(qc, dec)
+                    d_by_key[sb.key] = d_
+                    if not self._prediv:
+                        dc = d_.astype(cfg.inv_dtype)
+                        if hc is not None:
+                            dc = jnp.where(
+                                ok_slots[sb.key][:, None], dc, prev_d[sb.key]
+                            )
+                        d_out[sb.key] = jax.lax.with_sharding_constraint(
+                            dc, dec
+                        )
+
+            side(self.a_store, state.a, state.qa, state.da, qa, da,
+                 d_a_by_key, ok_a_slots)
+            side(self.g_store, state.g, state.qg, state.dg, qg, dg,
+                 d_g_by_key, ok_g_slots)
             if self._prediv:
                 # colocate-only (enforced in __post_init__): side keys are
                 # the pair-bucket keys, so eigenvalue stacks align by slot
                 for b in self.buckets:
                     fused = jax.vmap(
-                        lambda da_, dg_: factors_lib.prediv_eigenvalues(
+                        lambda da_, dg_, dm: factors_lib.prediv_eigenvalues(
                             factors_lib.EigenDecomp(q=None, d=da_),
                             factors_lib.EigenDecomp(q=None, d=dg_),
-                            damping,
+                            dm,
                         )
-                    )(d_a_by_key[b.key], d_g_by_key[b.key])
-                    dgda[b.key] = jax.lax.with_sharding_constraint(
-                        fused.astype(cfg.inv_dtype), dec
+                    )(
+                        d_a_by_key[b.key], d_g_by_key[b.key],
+                        jnp.broadcast_to(
+                            jnp.asarray(
+                                slot_damping(b.layers, b.padded), jnp.float32
+                            ),
+                            (b.padded,),
+                        ),
                     )
-            return state._replace(
+                    fc = fused.astype(cfg.inv_dtype)
+                    if hc is not None:
+                        okv = jnp.isfinite(fused).all(axis=(-2, -1))
+                        ok_fused[b.key] = okv
+                        fc = jnp.where(
+                            okv[:, None, None], fc, state.dgda[b.key]
+                        )
+                    dgda[b.key] = jax.lax.with_sharding_constraint(fc, dec)
+            state = state._replace(
                 qa=qa, qg=qg, da=da, dg=dg, dgda=dgda,
                 inv_damping=jnp.asarray(damping, jnp.float32),
             )
-        a_inv, g_inv = {}, {}
-        for sb in self.a_store:
-            a_inv[sb.key] = jax.lax.with_sharding_constraint(
-                self._sharded_inv(
-                    state.a[sb.key], damping, prev=state.a_inv[sb.key]
-                ).astype(cfg.inv_dtype),
-                dec,
+        else:
+            a_inv, g_inv = {}, {}
+
+            def side(store, side_state, prev, out, ok_slots):
+                for sb in store:
+                    cand = self._sharded_inv(
+                        side_state[sb.key],
+                        slot_damping(sb.layers, sb.padded),
+                        prev=prev[sb.key],
+                    ).astype(cfg.inv_dtype)
+                    if hc is not None:
+                        okv = jnp.isfinite(cand).all(axis=(-2, -1))
+                        ok_slots[sb.key] = okv
+                        cand = jnp.where(
+                            okv[:, None, None], cand, prev[sb.key]
+                        )
+                    out[sb.key] = jax.lax.with_sharding_constraint(cand, dec)
+
+            side(self.a_store, state.a, state.a_inv, a_inv, ok_a_slots)
+            side(self.g_store, state.g, state.g_inv, g_inv, ok_g_slots)
+            state = state._replace(
+                a_inv=a_inv, g_inv=g_inv,
+                inv_damping=jnp.asarray(damping, jnp.float32),
             )
-        for sb in self.g_store:
-            g_inv[sb.key] = jax.lax.with_sharding_constraint(
-                self._sharded_inv(
-                    state.g[sb.key], damping, prev=state.g_inv[sb.key]
-                ).astype(cfg.inv_dtype),
-                dec,
-            )
-        return state._replace(
-            a_inv=a_inv, g_inv=g_inv,
-            inv_damping=jnp.asarray(damping, jnp.float32),
-        )
+        if hc is not None:
+            # degradation counter: a refresh is quarantined when it ran
+            # from a quarantined (rolled-back) factor or produced a
+            # non-finite output on either side
+            bad_inv = {}
+            for n in self.registry.layers:
+                ak, ai = self._a_slot[n]
+                gk, gi = self._g_slot[n]
+                okn = ok_a_slots[ak][ai] & ok_g_slots[gk][gi]
+                if self._prediv:
+                    okn = okn & ok_fused[ak][ai]
+                bad_inv[n] = health_lib.inversion_update(
+                    hc, okn, h.quarantined[n], h.bad_inv[n]
+                )
+            state = state._replace(health=h._replace(bad_inv=bad_inv))
+        return state
 
     def inverse_residuals(
         self, state: DistKFACState
@@ -746,27 +926,24 @@ class DistributedKFAC:
         pmats: dict[str, jax.Array] = {}
         vg = jnp.zeros((), jnp.float32)
         for b in self.buckets:
-            # pin each matrix to replicated before stacking: TP/SP leaves
-            # per-layer grads model-sharded, and a mixed-sharding concat
-            # forces XLA's involuntary full rematerialization of the stack
-            # (same pattern as _stack_stats)
-            rows = [
-                pad_grad(
-                    jax.lax.with_sharding_constraint(
-                        self.registry.layers[n].grads_to_matrix(
-                            layer_grads[n]
-                        ),
-                        rep,
-                    ),
-                    b.dg,
-                    b.da,
+            # pin each matrix to replicated before inserting: TP/SP leaves
+            # per-layer grads model-sharded, and mixed shardings force
+            # XLA's involuntary full rematerialization of the stack (same
+            # pattern as _stack_stats). Built by dynamic-update-slice into
+            # a zeros buffer rather than concatenate: GSPMD mispartitions
+            # the concat-of-broadcasts under the slot-sharded constraint
+            # on fractional grad-worker meshes, resolving the unused row
+            # axis as partial-sum and inflating the stack by the
+            # grad-worker count.
+            gstack = jnp.zeros((b.padded, b.dg, b.da), cfg.inv_dtype)
+            for i, n in enumerate(b.layers):
+                gm = jax.lax.with_sharding_constraint(
+                    self.registry.layers[n].grads_to_matrix(layer_grads[n]),
+                    rep,
                 )
-                for n in b.layers
-            ]
-            pad = b.padded - len(b.layers)
-            if pad:
-                rows += [jnp.zeros((b.dg, b.da), rows[0].dtype)] * pad
-            gstack = jnp.stack(rows).astype(cfg.inv_dtype)
+                gstack = gstack.at[i].set(
+                    pad_grad(gm, b.dg, b.da).astype(cfg.inv_dtype)
+                )
             gstack = jax.lax.with_sharding_constraint(gstack, dec)
 
             def asm(side_dict, slot_map, row_shape):
@@ -806,24 +983,66 @@ class DistributedKFAC:
                 qg = asm(state.qg, self._g_slot, (b.dg, b.dg))
                 dada = asm(state.da, self._a_slot, (b.da,))
                 dgdg = asm(state.dg, self._g_slot, (b.dg,))
+                # per-slot escalated damping bites here for the non-prediv
+                # EIGEN method (its damping enters at precondition time);
+                # prediv/INVERSE bake it into update_inverses
+                if cfg.health is not None:
+                    dmp = damping * self._slot_mults(
+                        state.health, b.layers, b.padded
+                    )
+                else:
+                    dmp = jnp.broadcast_to(
+                        jnp.asarray(damping, jnp.float32), (b.padded,)
+                    )
 
-                def prec(gm, qa_, qg_, da_, dg_):
+                def prec(gm, qa_, qg_, da_, dg_, dm):
                     v1 = qg_.T @ gm @ qa_
-                    v2 = v1 / (jnp.outer(dg_, da_) + damping)
+                    v2 = v1 / (jnp.outer(dg_, da_) + dm)
                     return qg_ @ v2 @ qa_.T
 
-                pstack = jax.vmap(prec)(gstack, qa, qg, dada, dgdg)
+                pstack = jax.vmap(prec)(gstack, qa, qg, dada, dgdg, dmp)
             else:
                 pstack = jax.vmap(lambda gm, ai, gi: gi @ gm @ ai)(
                     gstack,
                     asm(state.a_inv, self._a_slot, (b.da, b.da)),
                     asm(state.g_inv, self._g_slot, (b.dg, b.dg)),
                 )
-            if cfg.kl_clip is not None:
-                vg = vg + jnp.sum(
-                    pstack.astype(jnp.float32) * gstack.astype(jnp.float32)
-                ) * (lr**2)
             pmats[b.key] = pstack
+
+        # Extraction, graceful degradation, and KL clipping all happen on
+        # replicated per-layer true-dim matrices — NOT at stack level.
+        # Mixing gstack into outputs or reductions at stack level flips its
+        # row-axis replication to partial-sum under GSPMD at fractional
+        # grad-worker meshes and inflates values by the grad-worker count;
+        # the per-layer form also matches the dense engine's vg semantics
+        # exactly (kfac_tpu/preconditioner.py:precondition).
+        mats: dict[str, jax.Array] = {}
+        for b in self.buckets:
+            # KAISA gradient broadcast: replicate the preconditioned stack.
+            pstack = jax.lax.with_sharding_constraint(pmats[b.key], rep)
+            for i, name in enumerate(b.layers):
+                helper = self.registry.layers[name]
+                dag, dgg = b.dims[i]
+                pmat = pstack[i][:dgg, :dag]
+                gmat = helper.grads_to_matrix(layer_grads[name])
+                if cfg.health is not None:
+                    # graceful degradation: a layer past degrade_after
+                    # consecutive quarantined inversions bypasses its
+                    # preconditioner — the raw gradient flows through
+                    # (still KL-clipped with the rest), first-order per
+                    # layer
+                    pmat = jnp.where(
+                        health_lib.is_degraded(
+                            cfg.health, state.health.bad_inv[name]
+                        ),
+                        gmat.astype(pmat.dtype),
+                        pmat,
+                    )
+                if cfg.kl_clip is not None:
+                    vg = vg + jnp.sum(
+                        pmat.astype(jnp.float32) * gmat.astype(jnp.float32)
+                    ) * (lr**2)
+                mats[name] = pmat
 
         if cfg.kl_clip is not None:
             kl_clip = _resolve(cfg.kl_clip, state.step)
@@ -832,19 +1051,12 @@ class DistributedKFAC:
             scale = None
 
         out: dict[str, dict[str, jax.Array]] = {}
-        for b in self.buckets:
-            pstack = pmats[b.key]
+        for name, pmat in mats.items():
+            helper = self.registry.layers[name]
+            ref_dtype = layer_grads[name][next(iter(layer_grads[name]))].dtype
             if scale is not None:
-                pstack = pstack * scale
-            # KAISA gradient broadcast: replicate the preconditioned stack.
-            pstack = jax.lax.with_sharding_constraint(pstack, rep)
-            for i, name in enumerate(b.layers):
-                helper = self.registry.layers[name]
-                ref_dtype = layer_grads[name][next(iter(layer_grads[name]))].dtype
-                dag, dgg = b.dims[i]
-                out[name] = helper.matrix_to_grads(
-                    pstack[i][:dgg, :dag].astype(ref_dtype)
-                )
+                pmat = pmat * scale
+            out[name] = helper.matrix_to_grads(pmat.astype(ref_dtype))
         return registry_lib.merge_layer_grads(grads, out, self.registry)
 
     # ------------------------------------------------------------------ step

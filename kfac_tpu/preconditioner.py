@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from kfac_tpu import enums
+from kfac_tpu import health as health_lib
 from kfac_tpu import warnings as kfac_warnings
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
@@ -75,6 +76,9 @@ class KFACState(NamedTuple):
     ``qa``/``qg``/``da``/``dg``: eigendecompositions (EIGEN method).
     ``a_inv``/``g_inv``: explicit inverses (INVERSE method).
     ``dgda``: fused ``1/(dg (x) da + damping)`` when prediv is enabled.
+    ``health``: :class:`kfac_tpu.health.HealthState` counters when the
+    numerical-health sentinel is enabled, else ``None`` (an empty pytree
+    subtree — zero state, zero cost).
     Unused method slots hold empty dicts so the pytree structure is static
     per-configuration.
     """
@@ -89,6 +93,7 @@ class KFACState(NamedTuple):
     dgda: dict[str, jax.Array]
     a_inv: dict[str, jax.Array]
     g_inv: dict[str, jax.Array]
+    health: Any = None
 
 
 @dataclasses.dataclass
@@ -201,8 +206,27 @@ class KFACPreconditioner:
     # once — and keeps each collective inside the interconnect's
     # comfortable message size. None = unbounded (single buffer).
     allreduce_bucket_cap_mb: float | None = 25.0
+    # Numerical-health sentinel (kfac_tpu/health.py, docs/ROBUSTNESS.md):
+    # skip-step, per-layer factor quarantine with escalated damping, and
+    # graceful degradation to raw-gradient updates. None disables all
+    # health machinery (reference semantics: a non-finite capture poisons
+    # the run); True enables HealthConfig defaults; or pass a
+    # health.HealthConfig to tune thresholds. Honored by both engines and
+    # by Trainer's skip-step gate.
+    health: health_lib.HealthConfig | bool | None = None
 
     def __post_init__(self) -> None:
+        if self.health is True:
+            self.health = health_lib.HealthConfig()
+        elif self.health is False:
+            self.health = None
+        elif self.health is not None and not isinstance(
+            self.health, health_lib.HealthConfig
+        ):
+            raise TypeError(
+                'health must be a HealthConfig, True, False, or None; got '
+                f'{self.health!r}'
+            )
         if isinstance(self.compute_method, str):
             try:
                 self.compute_method = enums.ComputeMethod[self.compute_method.upper()]
@@ -362,6 +386,10 @@ class KFACPreconditioner:
             step=jnp.asarray(0, dtype=jnp.int32),
             a=a, g=g, qa=qa, qg=qg, da=da, dg=dg, dgda=dgda,
             a_inv=a_inv, g_inv=g_inv,
+            health=(
+                health_lib.init_health(self.registry.layers)
+                if self.health is not None else None
+            ),
         )
 
     # --------------------------------------------------------------- factors
@@ -407,7 +435,43 @@ class KFACPreconditioner:
             if n in stats.g else state.g[n]
             for n in state.g
         }
-        return state._replace(a=new_a, g=new_g)
+        if self.health is None:
+            return state._replace(a=new_a, g=new_g)
+
+        # factor quarantine: a non-finite or quarantine-threshold-violating
+        # candidate rolls BOTH of the layer's factors back to their previous
+        # (healthy) values and escalates the layer's damping multiplier;
+        # healthy updates decay the multiplier back toward 1. Layers not in
+        # this capture (unexecuted) get no verdict — their factors did not
+        # move. The verdict is taken at the layer's EFFECTIVE damping: an
+        # already-escalated layer is judged by the inverse it would actually
+        # compute.
+        cfg = self.health
+        h = state.health
+        damping = _resolve(self.damping, state.step)
+        mult = dict(h.damping_mult)
+        quarantined = dict(h.quarantined)
+        events = dict(h.quarantine_events)
+        for n in state.a:
+            if n not in stats.a and n not in stats.g:
+                continue
+            eff = damping * h.damping_mult[n]
+            ok = health_lib.factor_ok(
+                new_a[n], eff, cfg.quarantine_threshold
+            ) & health_lib.factor_ok(new_g[n], eff, cfg.quarantine_threshold)
+            new_a[n] = jnp.where(ok, new_a[n], state.a[n])
+            new_g[n] = jnp.where(ok, new_g[n], state.g[n])
+            mult[n], quarantined[n], events[n] = health_lib.quarantine_update(
+                cfg, ok, h.damping_mult[n], h.quarantined[n],
+                h.quarantine_events[n],
+            )
+        return state._replace(
+            a=new_a, g=new_g,
+            health=h._replace(
+                damping_mult=mult, quarantined=quarantined,
+                quarantine_events=events,
+            ),
+        )
 
     # -------------------------------------------------------------- inverses
 
@@ -415,8 +479,28 @@ class KFACPreconditioner:
         """Recompute eigendecompositions (or inverses) from current factors.
 
         Reference: kfac/layers/eigen.py:295-348, kfac/layers/inverse.py:186-213.
+
+        With the health sentinel enabled, each layer's decomposition runs at
+        its EFFECTIVE damping (``damping * damping_mult``); a non-finite
+        result rolls back to the layer's previous decomposition, and the
+        degradation counter (``bad_inv``) advances whenever the refresh was
+        *quarantined* — ran from a quarantined factor or produced a
+        non-finite output — and recovers on healthy refreshes.
         """
         damping = _resolve(self.damping, state.step)
+        cfg = self.health
+        h = state.health
+        bad_inv = dict(h.bad_inv) if cfg is not None else {}
+
+        def eff_damping(name):
+            if cfg is None:
+                return damping
+            return damping * h.damping_mult[name]
+
+        def outputs_ok(*arrays):
+            flags = [jnp.isfinite(x).all() for x in arrays]
+            return jnp.stack(flags).all()
+
         if self.compute_method == enums.ComputeMethod.EIGEN:
             qa, qg = dict(state.qa), dict(state.qg)
             da, dg = dict(state.da), dict(state.dg)
@@ -428,26 +512,58 @@ class KFACPreconditioner:
                 gdec = factors_lib.compute_eigh(
                     state.g[name], self.inv_dtype, self.eigh_impl
                 )
-                qa[name], qg[name] = adec.q, gdec.q
+                cand = {'qa': adec.q, 'qg': gdec.q}
                 if self.prediv_eigenvalues:
-                    dgda[name] = factors_lib.prediv_eigenvalues(
-                        adec, gdec, damping
+                    cand['dgda'] = factors_lib.prediv_eigenvalues(
+                        adec, gdec, eff_damping(name)
                     ).astype(self.inv_dtype)
                 else:
-                    da[name], dg[name] = adec.d, gdec.d
-            return state._replace(qa=qa, qg=qg, da=da, dg=dg, dgda=dgda)
-        # warm-start Newton-Schulz from the previous inverse: the factor
-        # EMA drifts slowly between inv_update_steps refreshes, so the old
-        # inverse is deep in the quadratic basin (the safeguard inside
-        # newton_schulz_inverse_info falls back to the Gershgorin cold
-        # start for the all-zeros inverses of a fresh state)
-        inv = lambda f, prev: factors_lib.damped_inverse(
-            f, damping, self.inv_dtype, self.inverse_solver,
-            self.newton_schulz_iters, x0=prev,
-        )
-        a_inv = {n: inv(state.a[n], state.a_inv[n]) for n in state.a}
-        g_inv = {n: inv(state.g[n], state.g_inv[n]) for n in state.g}
-        return state._replace(a_inv=a_inv, g_inv=g_inv)
+                    cand['da'], cand['dg'] = adec.d, gdec.d
+                if cfg is not None:
+                    ok = outputs_ok(*cand.values())
+                    prev = {
+                        'qa': state.qa[name], 'qg': state.qg[name],
+                        'dgda': state.dgda.get(name),
+                        'da': state.da.get(name), 'dg': state.dg.get(name),
+                    }
+                    cand = {
+                        k: jnp.where(ok, v, prev[k]) for k, v in cand.items()
+                    }
+                    bad_inv[name] = health_lib.inversion_update(
+                        cfg, ok, h.quarantined[name], h.bad_inv[name]
+                    )
+                qa[name], qg[name] = cand['qa'], cand['qg']
+                if self.prediv_eigenvalues:
+                    dgda[name] = cand['dgda']
+                else:
+                    da[name], dg[name] = cand['da'], cand['dg']
+            state = state._replace(qa=qa, qg=qg, da=da, dg=dg, dgda=dgda)
+        else:
+            # warm-start Newton-Schulz from the previous inverse: the factor
+            # EMA drifts slowly between inv_update_steps refreshes, so the
+            # old inverse is deep in the quadratic basin (the safeguard
+            # inside newton_schulz_inverse_info falls back to the Gershgorin
+            # cold start for the all-zeros inverses of a fresh state)
+            inv = lambda f, prev, dmp: factors_lib.damped_inverse(
+                f, dmp, self.inv_dtype, self.inverse_solver,
+                self.newton_schulz_iters, x0=prev,
+            )
+            a_inv, g_inv = dict(state.a_inv), dict(state.g_inv)
+            for name in state.a:
+                cand_a = inv(state.a[name], state.a_inv[name], eff_damping(name))
+                cand_g = inv(state.g[name], state.g_inv[name], eff_damping(name))
+                if cfg is not None:
+                    ok = outputs_ok(cand_a, cand_g)
+                    cand_a = jnp.where(ok, cand_a, state.a_inv[name])
+                    cand_g = jnp.where(ok, cand_g, state.g_inv[name])
+                    bad_inv[name] = health_lib.inversion_update(
+                        cfg, ok, h.quarantined[name], h.bad_inv[name]
+                    )
+                a_inv[name], g_inv[name] = cand_a, cand_g
+            state = state._replace(a_inv=a_inv, g_inv=g_inv)
+        if cfg is not None:
+            state = state._replace(health=h._replace(bad_inv=bad_inv))
+        return state
 
     # --------------------------------------------------------- precondition
 
@@ -490,9 +606,24 @@ class KFACPreconditioner:
         precond: dict[str, dict[str, jax.Array]] = {}
         vg_terms = []
         lr = _resolve(self.lr, state.step)
+        cfg = self.health
+        h = state.health
         for name, helper in self.registry.layers.items():
             gmat = helper.grads_to_matrix(layer_grads[name])
-            pmat = self._precondition_one(state, name, gmat, damping)
+            # per-layer escalated damping bites here for the non-prediv
+            # EIGEN method (its damping enters at precondition time); the
+            # other methods bake it into update_inverses
+            eff = (
+                damping * h.damping_mult[name] if cfg is not None else damping
+            )
+            pmat = self._precondition_one(state, name, gmat, eff)
+            if cfg is not None:
+                # graceful degradation: a layer past degrade_after
+                # consecutive quarantined inversions is bypassed — the raw
+                # gradient direction flows through (still KL-clipped with
+                # the rest), first-order for this layer only
+                degraded = health_lib.is_degraded(cfg, h.bad_inv[name])
+                pmat = jnp.where(degraded, gmat.astype(pmat.dtype), pmat)
             if self.kl_clip is not None:
                 vg_terms.append(
                     jnp.sum(pmat.astype(jnp.float32) * gmat.astype(jnp.float32))
@@ -597,6 +728,14 @@ class KFACPreconditioner:
             f'layers, compute_method={self.compute_method.name}, '
             f'inverse_solver={self.inverse_solver}',
         ]
+        if self.health is not None:
+            hc = self.health
+            lines.append(
+                f'  health: skip_nonfinite={hc.skip_nonfinite} '
+                f'quarantine_threshold={hc.quarantine_threshold} '
+                f'damping_escalation={hc.damping_escalation} '
+                f'degrade_after={hc.degrade_after}'
+            )
         for name, h in self.registry.layers.items():
             lines.append(
                 f'  {name}: {type(h).__name__} '

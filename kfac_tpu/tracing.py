@@ -80,3 +80,32 @@ def log_trace(
     """Log the trace table (reference kfac/tracing.py:50-71)."""
     for key, value in sorted(get_trace(**kwargs).items()):
         logger.log(level, f'{label} {key}: {value:.6f}s')
+
+
+def health_counters(state: Any) -> dict[str, Any]:
+    """Flat numeric snapshot of an engine state's health counters.
+
+    Accepts a ``KFACState``/``DistKFACState`` (or a bare ``HealthState``)
+    and returns metric-logger-friendly scalars:
+    ``{'health/skipped_steps': ..., 'health/<layer>/damping_mult': ...,
+    'health/<layer>/quarantined': ..., 'health/<layer>/bad_inv': ...,
+    'health/<layer>/quarantine_events': ...}``. Empty when the health
+    sentinel is disabled. Synchronizes with the device (small transfer).
+    """
+    health = getattr(state, 'health', state)
+    if health is None or not hasattr(health, 'skipped_steps'):
+        return {}
+    vals = jax.device_get(health._asdict())
+    out: dict[str, Any] = {'health/skipped_steps': int(vals['skipped_steps'])}
+    for field in ('damping_mult', 'quarantined', 'bad_inv',
+                  'quarantine_events'):
+        for name, v in vals[field].items():
+            cast = float if field == 'damping_mult' else int
+            out[f'health/{name}/{field}'] = cast(v)
+    return out
+
+
+def log_health(state: Any, level: int = logging.INFO) -> None:
+    """Log the health counter snapshot (no-op when health is disabled)."""
+    for key, value in sorted(health_counters(state).items()):
+        logger.log(level, f'health: {key}: {value}')

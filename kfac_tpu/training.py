@@ -22,6 +22,7 @@ from typing import Any, Callable, NamedTuple
 import jax
 import optax
 
+from kfac_tpu import health as health_lib
 from kfac_tpu.layers import capture as capture_lib
 
 
@@ -102,15 +103,60 @@ class Trainer:
         params = optax.apply_updates(state.params, updates)
         return params, opt_state, new_model_state
 
+    def _health_cfg(self):
+        """The engine's HealthConfig, or None when the sentinel is off."""
+        if self.kfac is None:
+            return None
+        cfg = self.kfac.config if hasattr(self.kfac, 'config') else self.kfac
+        return getattr(cfg, 'health', None)
+
+    def _finish_step(self, state: TrainState, grads, stats, new_model_state,
+                     loss=None) -> TrainState:
+        """Run the preconditioner + optimizer update — or skip it wholesale.
+
+        With the health sentinel's ``skip_nonfinite`` guard armed, a single
+        fused finiteness reduction over the loss and every gradient leaf
+        gates the entire update through one ``lax.cond``: on a poisoned
+        batch the params, optimizer state, curvature factors, AND mutable
+        model state (batch stats) all stay put; only the step clock and
+        ``skipped_steps`` advance (the reference's grad-scaler-overflow
+        semantics, kfac/base_preconditioner.py:126-130, with the check on
+        device instead of a host ``.item()`` sync).
+        """
+
+        def apply(_):
+            kstate, pgrads = self.kfac.step(state.kfac_state, grads, stats)
+            params, opt_state, model_state = self._apply_update(
+                state, pgrads, new_model_state
+            )
+            return TrainState(params, opt_state, kstate, model_state)
+
+        hc = self._health_cfg()
+        if (
+            hc is None
+            or not hc.skip_nonfinite
+            or state.kfac_state.health is None
+        ):
+            return apply(None)
+
+        def skip(_):
+            return state._replace(
+                kfac_state=health_lib.mark_skipped(state.kfac_state)
+            )
+
+        checks = (grads,) if loss is None else (loss, grads)
+        return jax.lax.cond(
+            health_lib.all_finite(*checks), apply, skip, None
+        )
+
     def _step_with_stats(self, state: TrainState, batch):
         (loss, new_model_state), grads, stats = self._run_stats(
             state.params, (state.model_state, batch)
         )
-        kfac_state, grads = self.kfac.step(state.kfac_state, grads, stats)
-        params, opt_state, model_state = self._apply_update(
-            state, grads, new_model_state
+        new_state = self._finish_step(
+            state, grads, stats, new_model_state, loss=loss
         )
-        return TrainState(params, opt_state, kfac_state, model_state), loss
+        return new_state, loss
 
     def _step_no_stats(self, state: TrainState, batch):
         if self.kfac is None:
@@ -120,16 +166,19 @@ class Trainer:
             (loss, new_model_state), grads = jax.value_and_grad(
                 plain, has_aux=True
             )(state.params, state.model_state, batch)
-            kfac_state = state.kfac_state
-        else:
-            (loss, new_model_state), grads = jax.value_and_grad(
-                self.loss_fn, has_aux=True
-            )(state.params, state.model_state, batch)
-            kfac_state, grads = self.kfac.step(state.kfac_state, grads, None)
-        params, opt_state, model_state = self._apply_update(
-            state, grads, new_model_state
+            params, opt_state, model_state = self._apply_update(
+                state, grads, new_model_state
+            )
+            return TrainState(
+                params, opt_state, state.kfac_state, model_state
+            ), loss
+        (loss, new_model_state), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True
+        )(state.params, state.model_state, batch)
+        new_state = self._finish_step(
+            state, grads, None, new_model_state, loss=loss
         )
-        return TrainState(params, opt_state, kfac_state, model_state), loss
+        return new_state, loss
 
     # ------------------------------------------------------------- dispatch
 
@@ -156,6 +205,27 @@ class Trainer:
             cadence = max(1, int(cadence(self._step_count)))
         return self._step_count % cadence == 0
 
+    def check_health(self, state: TrainState) -> dict[str, Any]:
+        """Host-side health snapshot + rate-limited first-occurrence
+        warnings (quarantine / degradation per layer).
+
+        Returns :func:`kfac_tpu.health.summary`'s dict, or ``{}`` when the
+        sentinel is disabled. Synchronizes with the device (one small
+        transfer) — the eager step paths call this automatically when
+        ``HealthConfig.warn`` is set; compiled loops (:meth:`scan_steps`)
+        never do, so call it between scans if you want the warnings.
+        """
+        hc = self._health_cfg()
+        ks = state.kfac_state
+        if hc is None or ks is None or getattr(ks, 'health', None) is None:
+            return {}
+        return health_lib.check_and_warn(hc, ks.health, step=self._step_count)
+
+    def _maybe_warn(self, state: TrainState) -> None:
+        hc = self._health_cfg()
+        if hc is not None and hc.warn:
+            self.check_health(state)
+
     def step(self, state: TrainState, batch) -> tuple[TrainState, jax.Array]:
         """One optimization step; picks the capture variant on cadence."""
         self._sync_step_count(state)
@@ -164,6 +234,7 @@ class Trainer:
         else:
             out = self._jit_no_stats(state, batch)
         self._step_count += 1
+        self._maybe_warn(out[0])
         return out
 
     # ------------------------------------------------------- compiled loops
@@ -242,11 +313,8 @@ class Trainer:
         loss, new_ms, grads, stats = jax.lax.cond(
             capture_now, with_cap, no_cap, None
         )
-        kstate, grads = self.kfac.step(kstate, grads, stats)
-        params, opt_state, model_state = self._apply_update(
-            state, grads, new_ms
-        )
-        return TrainState(params, opt_state, kstate, model_state), loss
+        new_state = self._finish_step(state, grads, stats, new_ms, loss=loss)
+        return new_state, loss
 
     def scan_steps(
         self, state: TrainState, batches
@@ -382,14 +450,16 @@ class Trainer:
             else None
         )
         new_state = self._jit_apply_kfac(
-            state._replace(model_state=acc['model_state']),
+            state,
             grads_avg,
             stats_avg,
+            acc['model_state'],
             with_stats=acc['capture'],
         )
         loss = acc['loss'] / n
         self._accum = None
         self._step_count += 1
+        self._maybe_warn(new_state)
         return new_state, loss
 
     def step_accumulate(
@@ -479,31 +549,29 @@ class Trainer:
                     if with_stats
                     else None
                 )
-                kstate, grads = self.kfac.step(
-                    state.kfac_state, grads_avg, stats_avg
+                loss_avg = loss_sum / n
+                new_state = self._finish_step(
+                    state, grads_avg, stats_avg, model_state, loss=loss_avg
                 )
-                params, opt_state, new_ms = self._apply_update(
-                    state, grads, model_state
-                )
-                return TrainState(params, opt_state, kstate, new_ms), (
-                    loss_sum / n
-                )
+                return new_state, loss_avg
 
             self._jit_accum_scan = jax.jit(
                 accum, static_argnames=('with_stats',)
             )
         out = self._jit_accum_scan(state, microbatches, with_stats=capture_now)
         self._step_count += 1
+        self._maybe_warn(out[0])
         return out
 
-    def _apply_accumulated(self, state: TrainState, grads, stats, with_stats):
-        kfac_state, grads = self.kfac.step(
-            state.kfac_state, grads, stats if with_stats else None
+    def _apply_accumulated(
+        self, state: TrainState, grads, stats, new_model_state, with_stats
+    ):
+        # a single poisoned micro-batch propagates NaN into the summed
+        # grads, so the skip-step gate inside _finish_step drops the whole
+        # accumulated batch (and its model_state) in one decision
+        return self._finish_step(
+            state, grads, stats if with_stats else None, new_model_state
         )
-        params, opt_state, model_state = self._apply_update(
-            state, grads, state.model_state
-        )
-        return TrainState(params, opt_state, kfac_state, model_state)
 
 
 def jnp_add(a, b):

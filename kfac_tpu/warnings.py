@@ -1,6 +1,9 @@
-"""Warning categories (reference parity: kfac/warnings.py:6-9)."""
+"""Warning categories (reference parity: kfac/warnings.py:6-9) plus the
+rate-limited numerical-health event channel (kfac_tpu/health.py)."""
 
 from __future__ import annotations
+
+import warnings as _warnings
 
 
 class ExperimentalFeatureWarning(Warning):
@@ -9,3 +12,43 @@ class ExperimentalFeatureWarning(Warning):
 
 class TPUPerformanceWarning(Warning):
     """Configuration known to be pathologically slow on TPU backends."""
+
+
+class NumericalHealthWarning(Warning):
+    """A layer was quarantined or degraded by the health sentinel."""
+
+
+# (layer, cause) pairs already warned about — each fires ONCE per process,
+# not once per step: a persistently sick layer would otherwise spam the log
+# at training-step frequency while saying nothing new.
+_health_events_emitted: set[tuple[str, str]] = set()
+
+
+def warn_health_event(
+    layer: str,
+    step: int | None,
+    cause: str,
+    detail: str = '',
+) -> bool:
+    """Emit a structured, rate-limited :class:`NumericalHealthWarning`.
+
+    ``cause`` is a short event tag (``'quarantined'``, ``'degraded'``).
+    Returns True when a warning was actually emitted (first occurrence of
+    this (layer, cause)), False when rate-limited.
+    """
+    key = (layer, cause)
+    if key in _health_events_emitted:
+        return False
+    _health_events_emitted.add(key)
+    at = f' at step {step}' if step is not None else ''
+    msg = f'kfac-tpu health: layer {layer!r} {cause}{at}'
+    if detail:
+        msg += f' ({detail})'
+    _warnings.warn(msg, NumericalHealthWarning, stacklevel=2)
+    return True
+
+
+def reset_health_warnings() -> None:
+    """Forget emitted health events (tests; or after operator intervention
+    so a recurrence warns again)."""
+    _health_events_emitted.clear()

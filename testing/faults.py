@@ -1,0 +1,162 @@
+"""Deterministic numerical-fault injection for the health sentinel tests.
+
+Each injector takes healthy data and returns a poisoned copy — no RNG, no
+mutation of the input — so a fault test is exactly reproducible and the
+healthy original stays available for bitwise "nothing moved" assertions.
+Faults mirror the real-world failure modes the sentinel defends against
+(kfac_tpu/health.py): a corrupt input batch (dead loss/grads), a corrupt
+micro-batch inside an accumulation, poisoned curvature statistics, a
+factor blow-up past the conditioning bound, and factors corrupted at rest
+(e.g. a bad checkpoint).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kfac_tpu.layers import capture as capture_lib
+
+#: supported non-finite poison values by name
+POISONS = {
+    'nan': float('nan'),
+    'inf': float('inf'),
+    '-inf': float('-inf'),
+}
+
+
+def _poison_value(kind: str) -> float:
+    try:
+        return POISONS[kind]
+    except KeyError:
+        raise ValueError(
+            f'unknown poison kind {kind!r}; expected one of {sorted(POISONS)}'
+        ) from None
+
+
+def poison_batch(batch: Any, kind: str = 'nan', index: int = 0) -> Any:
+    """Poison one element of every array leaf of a ``(x, y, ...)`` batch.
+
+    Flattens each leaf and sets position ``index`` to the poison value —
+    a single bad training example is enough to drive loss and every
+    gradient non-finite, the skip-step trigger.
+    """
+    val = _poison_value(kind)
+
+    def leaf(x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.inexact):
+            return x
+        flat = x.reshape(-1)
+        return flat.at[index].set(val).reshape(x.shape)
+
+    return jax.tree_util.tree_map(leaf, batch)
+
+
+def poison_microbatch(
+    microbatches: Any, which: int, kind: str = 'nan'
+) -> Any:
+    """Poison micro-batch ``which`` of a stacked micro-batch pytree.
+
+    ``microbatches`` has a leading micro-batch axis on every leaf (the
+    :meth:`kfac_tpu.Trainer.step_accumulate_scan` input convention). One
+    poisoned micro-batch must make the whole accumulated step skip.
+    """
+    val = _poison_value(kind)
+
+    def leaf(x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.inexact):
+            return x
+        flat = x[which].reshape(-1)
+        return x.at[which].set(flat.at[0].set(val).reshape(x[which].shape))
+
+    return jax.tree_util.tree_map(leaf, microbatches)
+
+
+def poison_stats(
+    stats: capture_lib.CapturedStats,
+    layers: Any,
+    side: str = 'a',
+    kind: str = 'nan',
+) -> capture_lib.CapturedStats:
+    """Poison the captured ``A`` (or ``G``) statistics of the given layers.
+
+    Builds a NEW CapturedStats (custom pytree — no ``_replace``): the
+    factor-quarantine trigger, while grads stay finite so the skip-step
+    gate does NOT fire and the engine-level quarantine is isolated.
+    """
+    if side not in ('a', 'g'):
+        raise ValueError(f"side must be 'a' or 'g', got {side!r}")
+    if isinstance(layers, str):
+        layers = [layers]
+    val = _poison_value(kind)
+    a = dict(stats.a)
+    g = dict(stats.g)
+    tgt = a if side == 'a' else g
+    for name in layers:
+        if name not in tgt:
+            raise KeyError(
+                f'layer {name!r} not in captured stats {sorted(tgt)}'
+            )
+        tgt[name] = tgt[name] + val  # NaN/inf poisons every entry
+    return capture_lib.CapturedStats(a=a, g=g, w=dict(stats.w))
+
+
+def huge_stats(
+    stats: capture_lib.CapturedStats,
+    layers: Any,
+    scale: float = 1e30,
+    side: str = 'a',
+) -> capture_lib.CapturedStats:
+    """Blow the given layers' statistics up by ``scale`` — FINITE values
+    that push the factor's Gershgorin conditioning estimate past any sane
+    ``quarantine_threshold``, exercising the bound-based (rather than
+    finiteness-based) quarantine path."""
+    if side not in ('a', 'g'):
+        raise ValueError(f"side must be 'a' or 'g', got {side!r}")
+    if isinstance(layers, str):
+        layers = [layers]
+    a = dict(stats.a)
+    g = dict(stats.g)
+    tgt = a if side == 'a' else g
+    for name in layers:
+        if name not in tgt:
+            raise KeyError(
+                f'layer {name!r} not in captured stats {sorted(tgt)}'
+            )
+        tgt[name] = tgt[name] * scale
+    return capture_lib.CapturedStats(a=a, g=g, w=dict(stats.w))
+
+
+def poison_factors(
+    engine: Any,
+    state: Any,
+    layers: Any,
+    side: str = 'a',
+    kind: str = 'nan',
+) -> Any:
+    """Corrupt resident factors in an engine state (any engine layout).
+
+    Round-trips through ``extract_factors``/``insert_factors`` so the same
+    injector poisons the dense per-layer dicts and the stacked KAISA slot
+    buckets — the "factors corrupted at rest" scenario (bad checkpoint,
+    bit flip) that inversion-time health verdicts and
+    ``checkpoint.restore`` validation must catch.
+    """
+    if isinstance(layers, str):
+        layers = [layers]
+    val = _poison_value(kind)
+    factors = engine.extract_factors(state)
+    out = {}
+    for name, fg in factors.items():
+        fg = dict(fg)
+        if name in layers:
+            fg[side] = fg[side] + val
+        out[name] = fg
+    missing = set(layers) - set(factors)
+    if missing:
+        raise KeyError(f'layers {sorted(missing)} not in engine factors')
+    return engine.insert_factors(state, out)

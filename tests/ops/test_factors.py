@@ -302,6 +302,30 @@ def test_gershgorin_condition_bound_bounds_true_condition():
     assert bound <= true_cond * 32, (bound, true_cond)
 
 
+def test_gershgorin_condition_bound_finite_at_zero_damping():
+    """damping == 0 must saturate, not divide by zero: an inf (or 0/0 nan)
+    bound would poison every downstream comparison in the health sentinel
+    (inf * 0 in jnp.where, threshold compares)."""
+    f = _random_spd(8, 5)
+    bound = factors.gershgorin_condition_bound(jnp.asarray(f), 0.0)
+    assert bool(jnp.isfinite(bound))
+    # saturated: huge enough that any sane quarantine_threshold flags it
+    assert float(bound) > 1e30
+    # batched, with a per-matrix damping vector mixing zero and nonzero
+    stack = jnp.stack([jnp.asarray(f)] * 3)
+    damp = jnp.asarray([0.0, 1e-3, 1.0], jnp.float32)
+    bounds = factors.gershgorin_condition_bound(stack, damp)
+    assert bounds.shape == (3,)
+    assert bool(jnp.isfinite(bounds).all())
+    assert float(bounds[0]) > float(bounds[1]) > float(bounds[2])
+    # a NaN factor still fails closed: NaN bound compares False vs any
+    # threshold, so factor_ok quarantines it (health.factor_ok contract)
+    nan_bound = factors.gershgorin_condition_bound(
+        jnp.asarray(f) + jnp.nan, 0.01
+    )
+    assert not bool(nan_bound <= 1e8)
+
+
 def test_eig_host_matches_eigh_on_symmetric():
     """The non-symmetric escape hatch (reference kfac/layers/eigen.py:
     295-348 symmetric=False, torch.linalg.eig real-part): on an actually

@@ -1,0 +1,516 @@
+"""Numerical-health sentinel fault suite (deterministic injection).
+
+End-to-end proof of the three mechanisms in kfac_tpu/health.py — skip-step,
+per-layer factor quarantine, graceful degradation to first-order updates —
+driven by the injectors in testing/faults.py, under both the dense engine
+and the stacked distributed engine (both stat transports). Run with
+``make faults`` / ``pytest -m faults``.
+"""
+
+import warnings as py_warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import kfac_tpu
+from kfac_tpu import checkpoint, enums, tracing, training
+from kfac_tpu import health as health_lib
+from kfac_tpu import warnings as kfac_warnings
+from testing import faults, models
+
+pytestmark = pytest.mark.faults
+
+
+def _setup(**kw):
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=32, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    loss_fn = models.mse_loss(m)
+    kw.setdefault('health', health_lib.HealthConfig(warn=False))
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg, **kw)
+    return m, params, (x, y), reg, loss_fn, kfac
+
+
+def _capture(reg, loss_fn, params, batch):
+    cap = kfac_tpu.CurvatureCapture(reg)
+    (_, _), grads, stats = cap.value_stats_and_grad(loss_fn)(params, batch)
+    return grads, stats
+
+
+def _trainer(m, loss_fn2, kfac, lr=0.05):
+    return training.Trainer(
+        loss_fn=loss_fn2, optimizer=optax.sgd(lr), kfac=kfac
+    )
+
+
+def _trainer_loss(m):
+    def loss_fn(params, model_state, batch):
+        x, y = batch
+        pred = m.apply({'params': params}, x)
+        return jnp.mean((pred - y) ** 2), model_state
+
+    return loss_fn
+
+
+# ------------------------------------------------------------------ config
+
+
+def test_health_config_validation():
+    with pytest.raises(ValueError):
+        health_lib.HealthConfig(damping_escalation=0.5)
+    with pytest.raises(ValueError):
+        health_lib.HealthConfig(damping_decay=1.5)
+    with pytest.raises(ValueError):
+        health_lib.HealthConfig(degrade_after=0)
+    with pytest.raises(ValueError):
+        health_lib.HealthConfig(quarantine_threshold=0.5)
+    with pytest.raises(TypeError):
+        kfac_tpu.KFACPreconditioner(
+            registry=_setup()[3], health='yes'
+        )
+
+
+def test_health_disabled_is_reference_semantics():
+    """health=None: zero health state, and a poisoned batch poisons the
+    params (the reference's behavior the sentinel exists to prevent)."""
+    m, params, batch, reg, loss_fn, kfac = _setup(health=None)
+    state = kfac.init()
+    assert state.health is None
+    assert tracing.health_counters(state) == {}
+
+    trainer = _trainer(m, _trainer_loss(m), kfac)
+    tstate = trainer.init(params)
+    tstate, loss = trainer.step(tstate, faults.poison_batch(batch))
+    assert not bool(jnp.isfinite(loss))
+    kernel = tstate.params['fc1']['kernel']
+    assert not bool(jnp.isfinite(kernel).all())
+
+
+# --------------------------------------------------------------- skip-step
+
+
+def test_skip_step_eager_then_recovers():
+    m, params, batch, reg, loss_fn, kfac = _setup()
+    trainer = _trainer(m, _trainer_loss(m), kfac)
+    tstate = trainer.init(params)
+
+    bad = faults.poison_batch(batch, kind='nan')
+    t1, loss = trainer.step(tstate, bad)
+    assert not bool(jnp.isfinite(loss))
+    # the whole update was dropped: params bitwise unchanged
+    np.testing.assert_array_equal(
+        np.asarray(t1.params['fc1']['kernel']),
+        np.asarray(tstate.params['fc1']['kernel']),
+    )
+    assert int(t1.kfac_state.health.skipped_steps) == 1
+    # the clock still advanced (schedules/cadence stay aligned)
+    assert int(t1.kfac_state.step) == 1
+
+    # next healthy batch applies normally
+    t2, loss2 = trainer.step(t1, batch)
+    assert bool(jnp.isfinite(loss2))
+    assert int(t2.kfac_state.health.skipped_steps) == 1
+    assert (
+        float(
+            jnp.abs(
+                t2.params['fc1']['kernel'] - t1.params['fc1']['kernel']
+            ).max()
+        )
+        > 0
+    )
+
+
+@pytest.mark.parametrize('kind', ['inf', '-inf'])
+def test_skip_step_catches_infs_too(kind):
+    m, params, batch, reg, loss_fn, kfac = _setup()
+    trainer = _trainer(m, _trainer_loss(m), kfac)
+    tstate = trainer.init(params)
+    t1, _ = trainer.step(tstate, faults.poison_batch(batch, kind=kind))
+    assert int(t1.kfac_state.health.skipped_steps) == 1
+    np.testing.assert_array_equal(
+        np.asarray(t1.params['fc2']['kernel']),
+        np.asarray(tstate.params['fc2']['kernel']),
+    )
+
+
+def test_skip_step_accumulate_eager():
+    """One poisoned micro-batch drops the whole accumulated step."""
+    m, params, (x, y), reg, loss_fn, kfac = _setup()
+    trainer = _trainer(m, _trainer_loss(m), kfac)
+    tstate = trainer.init(params)
+    mbs = [(x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8]) for i in range(4)]
+    mbs[2] = faults.poison_batch(mbs[2])
+    t1, loss = trainer.step_accumulate(tstate, mbs)
+    assert int(t1.kfac_state.health.skipped_steps) == 1
+    np.testing.assert_array_equal(
+        np.asarray(t1.params['fc1']['kernel']),
+        np.asarray(tstate.params['fc1']['kernel']),
+    )
+    # healthy accumulation afterwards applies
+    good = [(x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8]) for i in range(4)]
+    t2, loss2 = trainer.step_accumulate(t1, good)
+    assert bool(jnp.isfinite(loss2))
+    assert int(t2.kfac_state.health.skipped_steps) == 1
+    assert (
+        float(
+            jnp.abs(
+                t2.params['fc1']['kernel'] - t1.params['fc1']['kernel']
+            ).max()
+        )
+        > 0
+    )
+
+
+def test_skip_step_accumulate_scan():
+    m, params, (x, y), reg, loss_fn, kfac = _setup()
+    trainer = _trainer(m, _trainer_loss(m), kfac)
+    tstate = trainer.init(params)
+    mbs = (x.reshape(4, 8, -1), y.reshape(4, 8, -1))
+    bad = faults.poison_microbatch(mbs, which=1)
+    t1, loss = trainer.step_accumulate_scan(tstate, bad)
+    assert int(t1.kfac_state.health.skipped_steps) == 1
+    np.testing.assert_array_equal(
+        np.asarray(t1.params['fc1']['kernel']),
+        np.asarray(tstate.params['fc1']['kernel']),
+    )
+    t2, loss2 = trainer.step_accumulate_scan(t1, mbs)
+    assert bool(jnp.isfinite(loss2))
+    assert int(t2.kfac_state.health.skipped_steps) == 1
+
+
+def test_skip_step_inside_scan_steps():
+    """A poisoned batch in the middle of a compiled lax.scan loop is
+    skipped on-device; the surrounding steps train normally."""
+    m, params, (x, y), reg, loss_fn, kfac = _setup()
+    trainer = _trainer(m, _trainer_loss(m), kfac)
+    tstate = trainer.init(params)
+    batches = (
+        jnp.stack([x, x, x]),
+        jnp.stack([y, y, y]),
+    )
+    batches = faults.poison_microbatch(batches, which=1)
+    t1, losses = trainer.scan_steps(tstate, batches)
+    assert int(t1.kfac_state.health.skipped_steps) == 1
+    assert int(t1.kfac_state.step) == 3
+    assert bool(jnp.isfinite(losses[0])) and bool(jnp.isfinite(losses[2]))
+    assert not bool(jnp.isfinite(losses[1]))
+    # params stayed finite through the poisoned step
+    assert bool(jnp.isfinite(t1.params['fc1']['kernel']).all())
+
+
+# -------------------------------------------------------- factor quarantine
+
+
+def test_quarantine_rollback_escalation_decay_dense():
+    m, params, batch, reg, loss_fn, kfac = _setup()
+    grads, stats = _capture(reg, loss_fn, params, batch)
+    state = kfac.init()
+    state = kfac.update_factors(state, stats)  # healthy baseline
+    a_before = np.asarray(state.a['fc1'])
+
+    bad = faults.poison_stats(stats, 'fc1', side='a', kind='nan')
+    s1 = kfac.update_factors(state, bad)
+    # fc1 rolled back to the previous factor, fc2 advanced on good stats
+    np.testing.assert_array_equal(np.asarray(s1.a['fc1']), a_before)
+    assert (
+        float(jnp.abs(s1.a['fc2'] - state.a['fc2']).max()) > 0
+    )
+    assert int(s1.health.quarantined['fc1']) == 1
+    assert int(s1.health.quarantine_events['fc1']) == 1
+    assert float(s1.health.damping_mult['fc1']) == pytest.approx(10.0)
+    assert int(s1.health.quarantined['fc2']) == 0
+    assert float(s1.health.damping_mult['fc2']) == pytest.approx(1.0)
+
+    # healthy update: consecutive counter resets, multiplier decays,
+    # cumulative event counter is monotone
+    s2 = kfac.update_factors(s1, stats)
+    assert int(s2.health.quarantined['fc1']) == 0
+    assert float(s2.health.damping_mult['fc1']) == pytest.approx(5.0)
+    assert int(s2.health.quarantine_events['fc1']) == 1
+    assert bool(jnp.isfinite(s2.a['fc1']).all())
+
+
+def test_quarantine_on_gershgorin_bound_blowup():
+    """A FINITE factor blow-up past the conditioning bound quarantines —
+    the fp32 inverse of a kappa~1e30 factor is garbage even when finite."""
+    m, params, batch, reg, loss_fn, kfac = _setup(damping=0.01)
+    _, stats = _capture(reg, loss_fn, params, batch)
+    state = kfac.init()
+    huge = faults.huge_stats(stats, 'fc1', scale=1e30, side='a')
+    s1 = kfac.update_factors(state, huge)
+    assert int(s1.health.quarantined['fc1']) == 1
+    np.testing.assert_array_equal(
+        np.asarray(s1.a['fc1']), np.eye(s1.a['fc1'].shape[0])
+    )
+    # with the conditioning check disabled, the same finite blow-up passes
+    kfac2 = kfac_tpu.KFACPreconditioner(
+        registry=reg,
+        damping=0.01,
+        health=health_lib.HealthConfig(quarantine_threshold=None, warn=False),
+    )
+    s2 = kfac2.update_factors(kfac2.init(), huge)
+    assert int(s2.health.quarantined['fc1']) == 0
+
+
+# ------------------------------------------------------ graceful degradation
+
+
+@pytest.mark.parametrize(
+    'method', [enums.ComputeMethod.EIGEN, enums.ComputeMethod.INVERSE]
+)
+def test_degradation_bypass_and_recovery_dense(method):
+    m, params, batch, reg, loss_fn, kfac = _setup(
+        compute_method=method,
+        kl_clip=None,
+        damping=0.01,
+        health=health_lib.HealthConfig(degrade_after=1, warn=False),
+    )
+    grads, stats = _capture(reg, loss_fn, params, batch)
+    state = kfac.init()
+    state = kfac.update_factors(state, stats)
+
+    # poisoned stats -> quarantined factor -> quarantined inversion
+    bad = faults.poison_stats(stats, 'fc1', side='g', kind='nan')
+    s1 = kfac.update_factors(state, bad)
+    s1 = kfac.update_inverses(s1)
+    assert int(s1.health.bad_inv['fc1']) == 1
+    pg = kfac.precondition(s1, grads)
+    # degraded layer: raw gradient passes through exactly
+    np.testing.assert_allclose(
+        np.asarray(pg['fc1']['kernel']),
+        np.asarray(grads['fc1']['kernel']),
+        rtol=1e-6,
+        atol=0,
+    )
+    # healthy layer is still genuinely preconditioned
+    assert (
+        float(jnp.abs(pg['fc2']['kernel'] - grads['fc2']['kernel']).max()) > 0
+    )
+
+    # recovery: healthy factor update + healthy inversion clears the counter
+    s2 = kfac.update_factors(s1, stats)
+    s2 = kfac.update_inverses(s2)
+    assert int(s2.health.bad_inv['fc1']) == 0
+    pg2 = kfac.precondition(s2, grads)
+    assert (
+        float(jnp.abs(pg2['fc1']['kernel'] - grads['fc1']['kernel']).max())
+        > 0
+    )
+
+
+def test_degraded_training_still_decreases_loss():
+    """With fc1 permanently degraded (poisoned stats every step), training
+    continues partially-first-order and the loss still goes down."""
+    m, params, batch, reg, loss_fn, kfac = _setup(
+        kl_clip=None,
+        damping=0.01,
+        lr=0.05,
+        health=health_lib.HealthConfig(degrade_after=1, warn=False),
+    )
+    state = kfac.init()
+    losses = []
+    step = jax.jit(kfac.step)
+    cap = kfac_tpu.CurvatureCapture(reg)
+    run = cap.value_stats_and_grad(loss_fn)
+    for _ in range(10):
+        (loss, _), grads, stats = run(params, batch)
+        losses.append(float(loss))
+        bad = faults.poison_stats(stats, 'fc1', side='a', kind='nan')
+        state, pg = step(state, grads, bad)
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - 0.05 * g, params, pg
+        )
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    snap = health_lib.summary(kfac.health, state.health)
+    assert snap['layers']['fc1']['status'] == 'degraded'
+    assert snap['layers']['fc2']['status'] == 'ok'
+
+
+def test_factors_poisoned_at_rest_degrade_at_next_refresh():
+    """Corruption of resident factors (bad restore, bit flip) is caught by
+    the inversion-time verdict even with no stats traffic at all."""
+    m, params, batch, reg, loss_fn, kfac = _setup(
+        kl_clip=None,
+        health=health_lib.HealthConfig(degrade_after=1, warn=False),
+    )
+    grads, stats = _capture(reg, loss_fn, params, batch)
+    state = kfac.update_factors(kfac.init(), stats)
+    state = faults.poison_factors(kfac, state, 'fc2', side='a', kind='nan')
+    s1 = kfac.update_inverses(state)
+    assert int(s1.health.bad_inv['fc2']) == 1
+    pg = kfac.precondition(s1, grads)
+    np.testing.assert_allclose(
+        np.asarray(pg['fc2']['kernel']),
+        np.asarray(grads['fc2']['kernel']),
+        rtol=1e-6,
+        atol=0,
+    )
+    assert bool(jnp.isfinite(pg['fc1']['kernel']).all())
+
+
+# ------------------------------------------------------- distributed engine
+
+WORLD = 8
+
+
+def _dist_setup(transport, frac=1.0, **cfg_kw):
+    from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+
+    mesh = kaisa_mesh(grad_worker_fraction=frac)
+    m = models.TinyModel(hidden=8, out=4)
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=WORLD * 8, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    cfg_kw.setdefault('health', health_lib.HealthConfig(warn=False))
+    cfg = kfac_tpu.KFACPreconditioner(
+        registry=reg, allreduce_method=transport, **cfg_kw
+    )
+    dk = DistributedKFAC(config=cfg, mesh=mesh)
+    loss_fn = models.mse_loss(m)
+    return m, params, (x, y), reg, cfg, dk, loss_fn
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    'transport',
+    [enums.AllreduceMethod.ALLREDUCE, enums.AllreduceMethod.ALLREDUCE_BUCKETED],
+)
+def test_stacked_quarantine_rollback(transport):
+    m, params, batch, reg, cfg, dk, loss_fn = _dist_setup(transport)
+    grads, stats = _capture(reg, loss_fn, params, batch)
+    state = dk.init()
+    state = jax.jit(dk.update_factors)(state, stats)
+    a_before = np.asarray(dk.extract_factors(state)['fc1']['a'])
+
+    bad = faults.poison_stats(stats, 'fc1', side='a', kind='nan')
+    s1 = jax.jit(dk.update_factors)(state, bad)
+    np.testing.assert_array_equal(
+        np.asarray(dk.extract_factors(s1)['fc1']['a']), a_before
+    )
+    assert int(s1.health.quarantined['fc1']) == 1
+    assert float(s1.health.damping_mult['fc1']) == pytest.approx(10.0)
+    assert int(s1.health.quarantined['fc2']) == 0
+    # fc2's EMA legitimately advanced on its good stats
+    assert bool(jnp.isfinite(dk.extract_factors(s1)['fc2']['a']).all())
+
+    s2 = jax.jit(dk.update_factors)(s1, stats)
+    assert int(s2.health.quarantined['fc1']) == 0
+    assert float(s2.health.damping_mult['fc1']) == pytest.approx(5.0)
+    assert int(s2.health.quarantine_events['fc1']) == 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('frac', [1.0, 0.5])
+def test_stacked_degradation_bypass(frac):
+    m, params, batch, reg, cfg, dk, loss_fn = _dist_setup(
+        enums.AllreduceMethod.ALLREDUCE,
+        frac=frac,
+        kl_clip=None,
+        damping=0.01,
+        health=health_lib.HealthConfig(degrade_after=1, warn=False),
+    )
+    grads, stats = _capture(reg, loss_fn, params, batch)
+    state = dk.init()
+    state = jax.jit(dk.update_factors)(state, stats)
+    bad = faults.poison_stats(stats, 'fc1', side='g', kind='nan')
+    s1 = jax.jit(dk.update_factors)(state, bad)
+    s1 = jax.jit(dk.update_inverses)(s1)
+    assert int(s1.health.bad_inv['fc1']) == 1
+    pg = jax.jit(dk.precondition)(s1, grads)
+    np.testing.assert_allclose(
+        np.asarray(pg['fc1']['kernel']),
+        np.asarray(grads['fc1']['kernel']),
+        rtol=1e-5,
+        atol=1e-7,
+    )
+    assert (
+        float(jnp.abs(pg['fc2']['kernel'] - grads['fc2']['kernel']).max()) > 0
+    )
+
+    s2 = jax.jit(dk.update_factors)(s1, stats)
+    s2 = jax.jit(dk.update_inverses)(s2)
+    assert int(s2.health.bad_inv['fc1']) == 0
+    pg2 = jax.jit(dk.precondition)(s2, grads)
+    assert (
+        float(jnp.abs(pg2['fc1']['kernel'] - grads['fc1']['kernel']).max())
+        > 0
+    )
+
+
+# ---------------------------------------------------- tracing / checkpoint
+
+
+def test_health_counters_snapshot():
+    m, params, batch, reg, loss_fn, kfac = _setup()
+    _, stats = _capture(reg, loss_fn, params, batch)
+    state = kfac.update_factors(
+        kfac.init(), faults.poison_stats(stats, 'fc1', side='a')
+    )
+    counters = tracing.health_counters(state)
+    assert counters['health/skipped_steps'] == 0
+    assert counters['health/fc1/quarantined'] == 1
+    assert counters['health/fc1/damping_mult'] == pytest.approx(10.0)
+    assert counters['health/fc2/quarantined'] == 0
+
+
+def test_checkpoint_health_roundtrip(tmp_path):
+    m, params, batch, reg, loss_fn, kfac = _setup()
+    grads, stats = _capture(reg, loss_fn, params, batch)
+    state = kfac.update_factors(kfac.init(), stats)
+    state = kfac.update_factors(
+        state, faults.poison_stats(stats, 'fc1', side='a')
+    )
+    state = health_lib.mark_skipped(state)
+    state = health_lib.mark_skipped(state)
+
+    path = str(tmp_path / 'ckpt')
+    checkpoint.save(path, state, engine=kfac)
+    restored, _ = checkpoint.restore(path, kfac)
+    assert int(restored.health.skipped_steps) == 2
+    assert int(restored.health.quarantined['fc1']) == 1
+    assert int(restored.health.quarantine_events['fc1']) == 1
+    assert float(restored.health.damping_mult['fc1']) == pytest.approx(10.0)
+    assert int(restored.health.quarantined['fc2']) == 0
+
+
+def test_restore_rejects_nonfinite_factors(tmp_path):
+    m, params, batch, reg, loss_fn, kfac = _setup()
+    _, stats = _capture(reg, loss_fn, params, batch)
+    state = kfac.update_factors(kfac.init(), stats)
+    state = faults.poison_factors(kfac, state, 'fc1', side='a', kind='nan')
+    path = str(tmp_path / 'ckpt')
+    checkpoint.save(path, state, engine=kfac)
+    with pytest.raises(ValueError, match='fc1'):
+        checkpoint.restore(path, kfac)
+
+
+# ------------------------------------------------------------------ warnings
+
+
+def test_health_warnings_fire_once():
+    kfac_warnings.reset_health_warnings()
+    m, params, batch, reg, loss_fn, _ = _setup()
+    _, stats = _capture(reg, loss_fn, params, batch)
+    cfg = health_lib.HealthConfig()  # warn=True defaults
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg, health=cfg)
+    state = kfac.update_factors(
+        kfac.init(), faults.poison_stats(stats, 'fc1', side='a')
+    )
+    with pytest.warns(kfac_warnings.NumericalHealthWarning, match='fc1'):
+        snap = health_lib.check_and_warn(cfg, state.health, step=1)
+    assert snap['layers']['fc1']['status'] == 'quarantined'
+    # second scan of the same condition is rate-limited: silent
+    with py_warnings.catch_warnings(record=True) as caught:
+        py_warnings.simplefilter('always')
+        health_lib.check_and_warn(cfg, state.health, step=2)
+    assert not [
+        w
+        for w in caught
+        if issubclass(w.category, kfac_warnings.NumericalHealthWarning)
+    ]
+    kfac_warnings.reset_health_warnings()
