@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults obs bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -36,6 +36,12 @@ test-integration:
 # distributed cases; see docs/ROBUSTNESS.md)
 faults:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m faults
+
+# telemetry spine: observability test suite + named-scope lint
+# (see docs/OBSERVABILITY.md)
+obs:
+	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py -q
+	$(TEST_ENV) $(PY) tools/lint_named_scopes.py
 
 bench:
 	$(PY) bench.py

@@ -274,6 +274,110 @@ def _timeit(step_for_iter, args, warmup: int = 5, iters: int = 100) -> float:
     return (time.perf_counter() - start) / iters
 
 
+def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
+    """Observability probe: per-step metrics JSONL, metrics-on overhead vs
+    a metrics-off loop timed back-to-back, and a phase-level step-time
+    breakdown.
+
+    Exercises the telemetry spine (docs/OBSERVABILITY.md) on the same
+    model the stage just timed. The overhead A/B re-times the metrics-off
+    loop here rather than reusing the stage's earlier K-FAC figure —
+    minutes-apart measurements on a shared host drift by more than the
+    overhead being measured. The caller guards it: a probe failure is
+    recorded (``obs_probe_error``) but never kills the stage's headline.
+    """
+    import jax
+    import optax
+
+    import kfac_tpu
+    from kfac_tpu.observability import sinks
+
+    def build(metrics):
+        kfac = kfac_tpu.KFACPreconditioner(
+            registry=reg, damping=0.003, lr=0.1,
+            factor_update_steps=10, inv_update_steps=100,
+            metrics=metrics,
+        )
+
+        @jax.jit
+        def cap_step(params, kstate, opt_state, batch):
+            (l, _), grads, stats = run(params, batch)
+            kstate, pgrads = kfac.step(kstate, grads, stats)
+            updates, opt_state = opt.update(pgrads, opt_state, params)
+            return optax.apply_updates(params, updates), kstate, opt_state, l
+
+        @jax.jit
+        def plain_step(params, kstate, opt_state, batch):
+            l, grads = jax.value_and_grad(loss)(params, batch)
+            kstate, pgrads = kfac.step(kstate, grads, None)
+            updates, opt_state = opt.update(pgrads, opt_state, params)
+            return optax.apply_updates(params, updates), kstate, opt_state, l
+
+        return kfac, cap_step, plain_step
+
+    kfac_m, cap_step, plain_step = build(True)
+
+    # 12-step eager loop draining the in-jit metrics to JSONL per step —
+    # the documented training-loop integration, verbatim
+    collector = kfac_tpu.MetricsCollector()
+    mpath = out_path + '.metrics.jsonl'
+    args = (params, kfac_m.init(), opt.init(params), data)
+    out = None
+    with sinks.JSONLWriter(mpath, append=False) as w:
+        for i in range(12):
+            fn = cap_step if i % 10 == 0 else plain_step
+            out = fn(*args)
+            args = (out[0], out[1], out[2], args[3])
+            w.write(collector.drain(out[1]))
+    jax.block_until_ready(out)
+    result['metrics_jsonl'] = mpath
+    # one compiled program per dispatch variant; anything above 2 means
+    # the metrics state retriggered compilation across steps
+    result['metrics_compilations'] = (
+        cap_step._cache_size() + plain_step._cache_size())
+
+    # metrics on/off A/B, alternating rounds back-to-back so shared-host
+    # load drift hits both sides equally (acceptance bar: < 5%)
+    kfac_o, cap_o, plain_o = build(None)
+    t_on = t_off = float('inf')
+    for _ in range(2):
+        t_off = min(t_off, _timeit(
+            lambda i: cap_o if i % 10 == 0 else plain_o,
+            (params, kfac_o.init(), opt.init(params), data),
+            warmup=2, iters=40,
+        ))
+        t_on = min(t_on, _timeit(
+            lambda i: cap_step if i % 10 == 0 else plain_step,
+            (params, kfac_m.init(), opt.init(params), data),
+            warmup=2, iters=40,
+        ))
+    result['metrics_overhead_pct'] = round((t_on / t_off - 1.0) * 100.0, 2)
+
+    # phase-level breakdown: each engine phase jitted alone and timed to
+    # completion — where a step's milliseconds actually go
+    phases: dict = {}
+
+    def _phase(name, fn, *a, n=10):
+        o = fn(*a)
+        jax.block_until_ready(o)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            o = fn(*a)
+        jax.block_until_ready(o)
+        phases[name] = round((time.perf_counter() - t0) / n * 1e3, 3)
+        return o
+
+    kstate = kfac_m.init()
+    jrun = jax.jit(run)
+    (_, _), grads, stats = jrun(params, data)
+    _phase('capture_ms', jrun, params, data)
+    kstate = _phase('factors_ms', jax.jit(kfac_m.update_factors),
+                    kstate, stats)
+    kstate = _phase('inverses_ms', jax.jit(kfac_m.update_inverses), kstate)
+    _phase('precondition_ms', jax.jit(kfac_m.precondition), kstate, grads)
+    result['step_breakdown_ms'] = phases
+
+
 # ---------------------------------------------------------------------------
 # LM measurement stage (runs in its own subprocess: `bench.py --stage lm`)
 # ---------------------------------------------------------------------------
@@ -504,6 +608,13 @@ def run_lm_stage(config_name: str, out_path: str) -> None:
         result['timing_suspect'] = True
     _atomic_write(out_path, result)
 
+    _log(f'lm_{config_name}: observability probe')
+    try:
+        _obs_probe(result, out_path, reg, run, loss, opt, params, data)
+    except Exception as e:  # never let telemetry kill the headline
+        result['obs_probe_error'] = f'{type(e).__name__}: {e}'
+    _atomic_write(out_path, result)
+
 
 # ---------------------------------------------------------------------------
 # ResNet measurement stage (manual-only: `bench.py --stage resnet --config X`)
@@ -696,6 +807,9 @@ _HEADLINE_KEYS = (
     # pick stays lm_flagship/lm_tiny)
     'sgd_images_per_sec', 'kfac_images_per_sec', 'n_kfac_layers',
     'step_gflops_xla',
+    # observability-probe fields (docs/OBSERVABILITY.md)
+    'metrics_jsonl', 'metrics_compilations', 'metrics_overhead_pct',
+    'step_breakdown_ms', 'obs_probe_error',
 )
 
 

@@ -10,7 +10,9 @@ torch.distributed.
 
 from kfac_tpu import compat  # noqa: F401  (installs JAX API shims first)
 from kfac_tpu import checkpoint, enums, health, hyperparams, tracing, warnings
+from kfac_tpu import observability
 from kfac_tpu.health import HealthConfig, HealthState
+from kfac_tpu.observability import MetricsCollector, MetricsConfig
 from kfac_tpu.preconditioner import default_compute_method
 from kfac_tpu.enums import (
     AllreduceMethod,
@@ -40,6 +42,8 @@ __all__ = [
     'HealthState',
     'KFACPreconditioner',
     'KFACState',
+    'MetricsCollector',
+    'MetricsConfig',
     'Registry',
     'health',
     'TrainState',
@@ -49,6 +53,7 @@ __all__ = [
     'enums',
     'hyperparams',
     'merge_registries',
+    'observability',
     'register_model',
     'tracing',
     'warnings',

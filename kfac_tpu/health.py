@@ -169,6 +169,22 @@ def init_health(names: Iterable[str]) -> HealthState:
     )
 
 
+def health_metric_keys(names: Iterable[str]) -> list[str]:
+    """The ``health/*`` key schema that ``tracing.health_counters`` emits.
+
+    Matches :func:`kfac_tpu.tracing.log_health` / the collector fold-in in
+    :class:`kfac_tpu.observability.metrics.MetricsCollector` key-for-key;
+    documented in docs/OBSERVABILITY.md.
+    """
+    keys = ['health/skipped_steps']
+    for n in names:
+        for field in (
+            'damping_mult', 'quarantined', 'bad_inv', 'quarantine_events'
+        ):
+            keys.append(f'health/{n}/{field}')
+    return keys
+
+
 # ----------------------------------------------------------------- predicates
 
 

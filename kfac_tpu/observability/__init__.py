@@ -1,0 +1,50 @@
+"""Telemetry spine: in-jit metrics, sinks, profiler, and comms accounting.
+
+Four small modules, one per concern:
+
+- :mod:`kfac_tpu.observability.metrics` — the in-jit per-layer scalar
+  state threaded through both engines and the one-``device_get`` drain.
+- :mod:`kfac_tpu.observability.sinks` — JSONL writer and rate-limited
+  logging adapter for the drained records.
+- :mod:`kfac_tpu.observability.profiler` — XLA profiler session helpers
+  (``StepTraceAnnotation`` per step, one-call capture).
+- :mod:`kfac_tpu.observability.comms` — host-side byte accounting for
+  the KAISA transports and size-class padding waste.
+
+See docs/OBSERVABILITY.md for the metric-key schema and quickstarts.
+"""
+
+from kfac_tpu.observability import comms
+from kfac_tpu.observability import metrics
+from kfac_tpu.observability import profiler
+from kfac_tpu.observability import sinks
+from kfac_tpu.observability.comms import comms_summary
+from kfac_tpu.observability.metrics import (
+    MetricsCollector,
+    MetricsConfig,
+    MetricsState,
+    metric_keys,
+)
+from kfac_tpu.observability.profiler import (
+    capture_steps,
+    profile_session,
+    step_annotation,
+)
+from kfac_tpu.observability.sinks import JSONLWriter, RateLimitedLogger
+
+__all__ = [
+    'JSONLWriter',
+    'MetricsCollector',
+    'MetricsConfig',
+    'MetricsState',
+    'RateLimitedLogger',
+    'capture_steps',
+    'comms',
+    'comms_summary',
+    'metric_keys',
+    'metrics',
+    'profile_session',
+    'profiler',
+    'sinks',
+    'step_annotation',
+]

@@ -1,0 +1,67 @@
+"""XLA profiler session helpers.
+
+Thin, opinionated wrappers over ``jax.profiler`` so a bench or training
+script gets a browsable trace directory with one call: a context manager
+for the trace session, a per-step ``StepTraceAnnotation`` so the
+profiler's step view lines up with training steps, and a one-call
+``capture_steps`` that runs a few annotated steps under a trace and
+blocks on the result (async dispatch would otherwise end the trace
+before the work does). The engine/Trainer ``named_scope`` wiring (see
+kfac_tpu/tracing.py) is what makes the captured timelines attributable
+to K-FAC phases.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Callable, Iterator
+
+import jax
+
+from kfac_tpu import tracing
+
+
+@contextlib.contextmanager
+def profile_session(logdir: str | os.PathLike[str]) -> Iterator[str]:
+    """Run the body under an XLA profiler trace written to ``logdir``.
+
+    View with TensorBoard's profile plugin or ``xprof`` pointed at the
+    directory. Nesting sessions is a jax error; keep one active.
+    """
+    path = os.fspath(logdir)
+    jax.profiler.start_trace(path)
+    try:
+        yield path
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step_annotation(step_num: int) -> Any:
+    """``StepTraceAnnotation`` for one training step.
+
+    Wrap the host-side dispatch of each step so the profiler groups
+    device activity per step: ``with step_annotation(n): train_step(...)``.
+    """
+    return jax.profiler.StepTraceAnnotation('train', step_num=int(step_num))
+
+
+def capture_steps(
+    logdir: str | os.PathLike[str],
+    step_fn: Callable[[int], Any],
+    steps: int = 3,
+) -> Any:
+    """One-call capture: trace ``steps`` annotated calls of ``step_fn``.
+
+    ``step_fn(i)`` receives the step index and typically closes over the
+    carried state. The final output pytree is blocked on before the
+    trace closes so every dispatched computation lands inside it.
+    Returns the last ``step_fn`` output.
+    """
+    out = None
+    with profile_session(logdir):
+        for i in range(int(steps)):
+            with step_annotation(i):
+                out = step_fn(i)
+        tracing._block_all(out)
+    return out

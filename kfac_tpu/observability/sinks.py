@@ -1,0 +1,101 @@
+"""Host-side sinks for drained telemetry records.
+
+Two destinations cover the common cases: an append-only structured JSONL
+file (one record per line, trivially greppable / pandas-loadable) and a
+rate-limited adapter onto the stdlib ``logging`` module for interactive
+runs, where emitting every step would drown the console.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, IO
+
+logger = logging.getLogger(__name__)
+
+
+def _json_default(value: Any) -> Any:
+    """Coerce numpy scalars/arrays that leak into records into JSON types."""
+    if hasattr(value, 'item') and getattr(value, 'ndim', 1) == 0:
+        return value.item()
+    if hasattr(value, 'tolist'):
+        return value.tolist()
+    raise TypeError(f'not JSON serializable: {type(value).__name__}')
+
+
+class JSONLWriter:
+    """Append telemetry records to a JSON-lines file.
+
+    Each ``write`` emits one compact JSON object per line and flushes, so
+    a crashed run keeps every completed step's record. Usable as a
+    context manager; ``write`` on an empty record is a no-op so callers
+    can drain unconditionally.
+    """
+
+    def __init__(self, path: str | os.PathLike[str], append: bool = True):
+        self.path = os.fspath(path)
+        self._file: IO[str] | None = open(self.path, 'a' if append else 'w')
+
+    def write(self, record: dict[str, Any]) -> None:
+        if not record:
+            return
+        if self._file is None:
+            raise ValueError(f'JSONLWriter({self.path!r}) is closed')
+        self._file.write(
+            json.dumps(record, default=_json_default, sort_keys=True) + '\n')
+        self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> 'JSONLWriter':
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+class RateLimitedLogger:
+    """Forward telemetry records to ``logging`` at most once per interval.
+
+    ``emit`` returns whether the record was actually logged, so callers
+    can pair it with an unconditional :class:`JSONLWriter` (full fidelity
+    on disk, sampled view on the console). A handful of headline keys are
+    always shown first; the remainder is summarized by count.
+    """
+
+    _HEADLINE = ('step', 'kl_clip_scale', 'health/skipped_steps')
+
+    def __init__(
+        self,
+        log: logging.Logger | None = None,
+        min_interval_s: float = 10.0,
+        level: int = logging.INFO,
+    ) -> None:
+        self.logger = log or logger
+        self.min_interval_s = float(min_interval_s)
+        self.level = level
+        self._last_emit: float | None = None
+
+    def emit(self, record: dict[str, Any]) -> bool:
+        if not record:
+            return False
+        now = time.monotonic()
+        if (self._last_emit is not None
+                and now - self._last_emit < self.min_interval_s):
+            return False
+        self._last_emit = now
+        head = [f'{k}={record[k]:g}' if isinstance(record[k], float)
+                else f'{k}={record[k]}'
+                for k in self._HEADLINE if k in record]
+        rest = sum(1 for k in record if k not in self._HEADLINE)
+        self.logger.log(
+            self.level,
+            'metrics: %s (+%d more keys)', ' '.join(head) or '<no headline>',
+            rest)
+        return True

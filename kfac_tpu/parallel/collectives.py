@@ -15,6 +15,8 @@ flat-buffer bucketing that trades many small collectives for one large one
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -112,6 +114,54 @@ def concat_flat_chunked(
         cur.append(t)
         cur_elems, cur_dtype = new_elems, new_dtype
     chunks.append(concat_flat(cur))
+    return chunks
+
+
+def plan_chunks(
+    specs: list[tuple[int, Any]],
+    max_bytes: int | float | None = None,
+) -> list[dict[str, Any]]:
+    """Host-side chunking plan: :func:`concat_flat_chunked` without arrays.
+
+    Mirrors the greedy in-order packing EXACTLY (promoted-dtype byte
+    accounting, oversized-tensor-own-chunk) from ``(n_elements, dtype)``
+    specs alone, so comms accounting (kfac_tpu/observability/comms.py) can
+    report the transport's chunk count and per-collective message sizes
+    without tracing a step. Returns one dict per chunk:
+    ``{'tensors', 'elements', 'bytes', 'dtype'}``.
+    """
+
+    def chunk(elems: int, count: int, dtype) -> dict[str, Any]:
+        dt = np.dtype(dtype)
+        return {
+            'tensors': count,
+            'elements': elems,
+            'bytes': elems * dt.itemsize,
+            'dtype': str(dt),
+        }
+
+    if not specs:
+        return []
+    if max_bytes is None:
+        elems = sum(int(n) for n, _ in specs)
+        dtype = specs[0][1]
+        for _, dt in specs[1:]:
+            dtype = jnp.result_type(dtype, dt)
+        return [chunk(elems, len(specs), dtype)]
+    chunks: list[dict[str, Any]] = []
+    cur_count = 0
+    cur_elems = 0
+    cur_dtype = None
+    for n, dt in specs:
+        new_dtype = dt if cur_dtype is None else jnp.result_type(cur_dtype, dt)
+        new_elems = cur_elems + int(n)
+        if cur_count and new_elems * np.dtype(new_dtype).itemsize > max_bytes:
+            chunks.append(chunk(cur_elems, cur_count, cur_dtype))
+            cur_count = 0
+            new_dtype, new_elems = dt, int(n)
+        cur_count += 1
+        cur_elems, cur_dtype = new_elems, new_dtype
+    chunks.append(chunk(cur_elems, cur_count, cur_dtype))
     return chunks
 
 

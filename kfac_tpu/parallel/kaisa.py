@@ -37,8 +37,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from kfac_tpu import assignment as assignment_lib
 from kfac_tpu import enums
 from kfac_tpu import health as health_lib
+from kfac_tpu import tracing
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
+from kfac_tpu.observability import comms as comms_lib
+from kfac_tpu.observability import metrics as metrics_lib
 from kfac_tpu.ops import factors as factors_lib
 from kfac_tpu.parallel import collectives
 from kfac_tpu.parallel import mesh as mesh_lib
@@ -193,6 +196,11 @@ class DistKFACState(NamedTuple):
     numerical-health sentinel is enabled, else ``None``. Per-layer scalars
     (replicated — layout-independent, so the same counters ride the dense
     and stacked states and survive cross-layout checkpoint migration).
+
+    ``metrics``: :class:`kfac_tpu.observability.MetricsState` per-layer
+    telemetry when metrics are enabled, else ``None``. Like ``health``,
+    layer-keyed replicated scalars — the same drained schema as the dense
+    engine, layout-independent.
     """
 
     step: jax.Array
@@ -207,6 +215,7 @@ class DistKFACState(NamedTuple):
     g_inv: dict[str, jax.Array]
     inv_damping: jax.Array
     health: Any = None
+    metrics: Any = None
 
 
 @dataclasses.dataclass
@@ -346,6 +355,18 @@ class DistributedKFAC:
             )
         else:
             health_sh = None
+        if self.config.metrics is not None:
+            names = tuple(self.registry.layers)
+            metrics_sh = metrics_lib.MetricsState(
+                names=names,
+                keys=tuple(metrics_lib.metric_keys(
+                    self.config.metrics, list(names))),
+                last_factor_step=rep,
+                last_inv_step=rep,
+                scalars=rep,
+            )
+        else:
+            metrics_sh = None
         return DistKFACState(
             step=rep,
             a=adict(fac),
@@ -359,6 +380,7 @@ class DistributedKFAC:
             g_inv={} if eigen else gdict(dec),
             inv_damping=rep,
             health=health_sh,
+            metrics=metrics_sh,
         )
 
     # ----------------------------------------------------------------- init
@@ -415,6 +437,12 @@ class DistributedKFAC:
                 health=(
                     health_lib.init_health(self.registry.layers)
                     if cfg.health is not None else None
+                ),
+                metrics=(
+                    metrics_lib.init_metrics(
+                        cfg.metrics, list(self.registry.layers)
+                    )
+                    if cfg.metrics is not None else None
                 ),
             )
 
@@ -551,6 +579,7 @@ class DistributedKFAC:
 
     # ------------------------------------------------------- factor updates
 
+    @tracing.scope('dist_kfac.update_factors')
     def update_factors(
         self, state: DistKFACState, stats: capture_lib.CapturedStats
     ) -> DistKFACState:
@@ -598,68 +627,114 @@ class DistributedKFAC:
 
         new_a = ema(self.a_store, state.a, a_stacks)
         new_g = ema(self.g_store, state.g, g_stacks)
-        if self.config.health is None:
-            return state._replace(a=new_a, g=new_g)
-
-        # factor quarantine, stacked form: one batched verdict per storage
-        # bucket (finite + Gershgorin at each slot's effective damping),
-        # combined per LAYER across its A and G slots so both factors roll
-        # back together — same semantics as the dense engine's per-layer
-        # loop (kfac_tpu/preconditioner.py:update_factors). Layers absent
-        # from this capture get no verdict (their stacked stat is their own
-        # state value — the EMA left them unchanged).
-        hc = self.config.health
-        h = state.health
-        damping = _resolve(self.config.damping, state.step)
         updated = set(stats.a) | set(stats.g)
-
-        def verdicts(store, stacks):
-            return {
-                sb.key: health_lib.factor_ok(
-                    stacks[sb.key],
-                    damping * self._slot_mults(h, sb.layers, sb.padded),
-                    hc.quarantine_threshold,
-                )
-                for sb in store
-            }
-
-        ok_a = verdicts(self.a_store, new_a)
-        ok_g = verdicts(self.g_store, new_g)
         ok: dict[str, jax.Array] = {}
-        for n in self.registry.layers:
-            if n not in updated:
-                continue
-            ak, ai = self._a_slot[n]
-            gk, gi = self._g_slot[n]
-            ok[n] = ok_a[ak][ai] & ok_g[gk][gi]
-        roll = {n: ~v for n, v in ok.items()}
+        new_health = state.health
+        if self.config.health is not None:
+            # factor quarantine, stacked form: one batched verdict per
+            # storage bucket (finite + Gershgorin at each slot's effective
+            # damping), combined per LAYER across its A and G slots so both
+            # factors roll back together — same semantics as the dense
+            # engine's per-layer loop
+            # (kfac_tpu/preconditioner.py:update_factors). Layers absent
+            # from this capture get no verdict (their stacked stat is their
+            # own state value — the EMA left them unchanged).
+            hc = self.config.health
+            h = state.health
+            damping = _resolve(self.config.damping, state.step)
 
-        def rollback(store, old, new):
-            out = {}
-            for sb in store:
-                mask = self._slot_mask(roll, sb.layers, sb.padded)
-                out[sb.key] = (
-                    new[sb.key] if mask is None
-                    else jnp.where(mask[:, None, None], old[sb.key], new[sb.key])
+            def verdicts(store, stacks):
+                return {
+                    sb.key: health_lib.factor_ok(
+                        stacks[sb.key],
+                        damping * self._slot_mults(h, sb.layers, sb.padded),
+                        hc.quarantine_threshold,
+                    )
+                    for sb in store
+                }
+
+            ok_a = verdicts(self.a_store, new_a)
+            ok_g = verdicts(self.g_store, new_g)
+            for n in self.registry.layers:
+                if n not in updated:
+                    continue
+                ak, ai = self._a_slot[n]
+                gk, gi = self._g_slot[n]
+                ok[n] = ok_a[ak][ai] & ok_g[gk][gi]
+            roll = {n: ~v for n, v in ok.items()}
+
+            def rollback(store, old, new):
+                out = {}
+                for sb in store:
+                    mask = self._slot_mask(roll, sb.layers, sb.padded)
+                    out[sb.key] = (
+                        new[sb.key] if mask is None
+                        else jnp.where(
+                            mask[:, None, None], old[sb.key], new[sb.key]
+                        )
+                    )
+                return out
+
+            mult = dict(h.damping_mult)
+            quarantined = dict(h.quarantined)
+            events = dict(h.quarantine_events)
+            for n, okn in ok.items():
+                mult[n], quarantined[n], events[n] = (
+                    health_lib.quarantine_update(
+                        hc, okn, h.damping_mult[n], h.quarantined[n],
+                        h.quarantine_events[n],
+                    )
                 )
-            return out
-
-        mult = dict(h.damping_mult)
-        quarantined = dict(h.quarantined)
-        events = dict(h.quarantine_events)
-        for n, okn in ok.items():
-            mult[n], quarantined[n], events[n] = health_lib.quarantine_update(
-                hc, okn, h.damping_mult[n], h.quarantined[n],
-                h.quarantine_events[n],
-            )
-        return state._replace(
-            a=rollback(self.a_store, state.a, new_a),
-            g=rollback(self.g_store, state.g, new_g),
-            health=h._replace(
+            new_a = rollback(self.a_store, state.a, new_a)
+            new_g = rollback(self.g_store, state.g, new_g)
+            new_health = h._replace(
                 damping_mult=mult, quarantined=quarantined,
                 quarantine_events=events,
-            ),
-        )
+            )
+        state = state._replace(a=new_a, g=new_g, health=new_health)
+        if self.config.metrics is not None and state.metrics is not None:
+            state = state._replace(
+                metrics=self._record_factor_metrics(state, updated, ok)
+            )
+        return state
+
+    def _record_factor_metrics(
+        self,
+        state: DistKFACState,
+        updated: set[str],
+        ok_verdicts: dict[str, jax.Array],
+    ) -> metrics_lib.MetricsState:
+        """Factor-phase telemetry from the post-rollback stacked factors.
+
+        Gershgorin bounds are taken on each layer's TRUE-dim block sliced
+        out of its class slot (the identity padding would otherwise clamp
+        both bounds toward 1), giving exact value parity with the dense
+        engine's per-layer bounds.
+        """
+        mcfg = self.config.metrics
+        ms = state.metrics
+        scalars: dict[str, jax.Array] = {}
+        touched: dict[str, jax.Array | None] = {}
+        for n, helper in self.registry.layers.items():
+            if n not in updated:
+                continue
+            if mcfg.factor_bounds:
+                ak, ai = self._a_slot[n]
+                gk, gi = self._g_slot[n]
+                da = helper.a_factor_shape[0]
+                dg = helper.g_factor_shape[0]
+                lmin_a, lmax_a = metrics_lib.gershgorin_bounds(
+                    state.a[ak][ai, :da, :da])
+                lmin_g, lmax_g = metrics_lib.gershgorin_bounds(
+                    state.g[gk][gi, :dg, :dg])
+                scalars[f'factor_lmin/a/{n}'] = lmin_a
+                scalars[f'factor_lmax/a/{n}'] = lmax_a
+                scalars[f'factor_lmin/g/{n}'] = lmin_g
+                scalars[f'factor_lmax/g/{n}'] = lmax_g
+            touched[n] = ok_verdicts.get(n)
+        return metrics_lib.update_scalars(ms, scalars)._replace(
+            last_factor_step=metrics_lib.advance_last(
+                ms.last_factor_step, ms.names, touched, state.step))
 
     # ------------------------------------------------------------- inverses
 
@@ -731,6 +806,7 @@ class DistributedKFAC:
             out_specs=spec, check_vma=False,
         )(stack, prev, dmp)
 
+    @tracing.scope('dist_kfac.update_inverses')
     def update_inverses(self, state: DistKFACState) -> DistKFACState:
         cfg = self.config
         hc = cfg.health
@@ -841,6 +917,7 @@ class DistributedKFAC:
                 a_inv=a_inv, g_inv=g_inv,
                 inv_damping=jnp.asarray(damping, jnp.float32),
             )
+        ok_layer: dict[str, jax.Array] = {}
         if hc is not None:
             # degradation counter: a refresh is quarantined when it ran
             # from a quarantined (rolled-back) factor or produced a
@@ -852,10 +929,17 @@ class DistributedKFAC:
                 okn = ok_a_slots[ak][ai] & ok_g_slots[gk][gi]
                 if self._prediv:
                     okn = okn & ok_fused[ak][ai]
+                ok_layer[n] = okn
                 bad_inv[n] = health_lib.inversion_update(
                     hc, okn, h.quarantined[n], h.bad_inv[n]
                 )
             state = state._replace(health=h._replace(bad_inv=bad_inv))
+        if cfg.metrics is not None and state.metrics is not None:
+            ms = state.metrics
+            touched = {n: ok_layer.get(n) for n in self.registry.layers}
+            state = state._replace(metrics=ms._replace(
+                last_inv_step=metrics_lib.advance_last(
+                    ms.last_inv_step, ms.names, touched, state.step)))
         return state
 
     def inverse_residuals(
@@ -908,13 +992,25 @@ class DistributedKFAC:
 
     # --------------------------------------------------------- precondition
 
-    def precondition(self, state: DistKFACState, grads: Any) -> Any:
+    @tracing.scope('dist_kfac.precondition')
+    def precondition(
+        self,
+        state: DistKFACState,
+        grads: Any,
+        metrics_out: dict[str, jax.Array] | None = None,
+    ) -> Any:
         """Precondition a params-shaped grad pytree via batched stacked math.
 
         Gradient stacks are laid out like the decompositions, so each column
         preconditions only its layers (its devices are the layer's "grad
         workers"); the final replication constraint is the KAISA gradient
         broadcast (reference kfac/layers/base.py:224-252).
+
+        ``metrics_out``, when given, collects this phase's telemetry
+        scalars at the replicated per-layer true-dim level (the same
+        place degradation/KL run — stack-level reductions would hit the
+        GSPMD partial-sum hazard described below); ``step`` merges them
+        into ``state.metrics``.
         """
         cfg = self.config
         damping = _resolve(cfg.damping, state.step)
@@ -1016,6 +1112,7 @@ class DistributedKFAC:
         # grad-worker meshes and inflates values by the grad-worker count;
         # the per-layer form also matches the dense engine's vg semantics
         # exactly (kfac_tpu/preconditioner.py:precondition).
+        mcfg = cfg.metrics if metrics_out is not None else None
         mats: dict[str, jax.Array] = {}
         for b in self.buckets:
             # KAISA gradient broadcast: replicate the preconditioned stack.
@@ -1025,6 +1122,17 @@ class DistributedKFAC:
                 dag, dgg = b.dims[i]
                 pmat = pstack[i][:dgg, :dag]
                 gmat = helper.grads_to_matrix(layer_grads[name])
+                if mcfg is not None:
+                    if mcfg.grad_norms:
+                        g32 = gmat.astype(jnp.float32)
+                        metrics_out[f'grad_norm/{name}'] = jnp.sqrt(
+                            jnp.sum(g32 * g32))
+                    eff = (
+                        damping * state.health.damping_mult[name]
+                        if cfg.health is not None else damping
+                    )
+                    metrics_out[f'damping_eff/{name}'] = jnp.asarray(
+                        eff, jnp.float32)
                 if cfg.health is not None:
                     # graceful degradation: a layer past degrade_after
                     # consecutive quarantined inversions bypasses its
@@ -1038,6 +1146,13 @@ class DistributedKFAC:
                         gmat.astype(pmat.dtype),
                         pmat,
                     )
+                if mcfg is not None and mcfg.grad_norms:
+                    # pre-scale norm, next to the kl_clip reduction's read
+                    # of pmat (one fused pass); rescaled by kl_clip_scale
+                    # below instead of re-reading the scaled tensor
+                    p32 = pmat.astype(jnp.float32)
+                    metrics_out[f'precond_grad_norm/{name}'] = jnp.sqrt(
+                        jnp.sum(p32 * p32))
                 if cfg.kl_clip is not None:
                     vg = vg + jnp.sum(
                         pmat.astype(jnp.float32) * gmat.astype(jnp.float32)
@@ -1049,6 +1164,11 @@ class DistributedKFAC:
             scale = factors_lib.kl_clip_scale(vg, kl_clip)
         else:
             scale = None
+        if mcfg is not None:
+            metrics_out['kl_clip_scale'] = (
+                scale.astype(jnp.float32) if scale is not None
+                else jnp.ones((), jnp.float32)
+            )
 
         out: dict[str, dict[str, jax.Array]] = {}
         for name, pmat in mats.items():
@@ -1056,11 +1176,16 @@ class DistributedKFAC:
             ref_dtype = layer_grads[name][next(iter(layer_grads[name]))].dtype
             if scale is not None:
                 pmat = pmat * scale
+                if mcfg is not None and mcfg.grad_norms:
+                    metrics_out[f'precond_grad_norm/{name}'] = (
+                        metrics_out[f'precond_grad_norm/{name}']
+                        * jnp.abs(scale.astype(jnp.float32)))
             out[name] = helper.matrix_to_grads(pmat.astype(ref_dtype))
         return registry_lib.merge_layer_grads(grads, out, self.registry)
 
     # ------------------------------------------------------------------ step
 
+    @tracing.scope('dist_kfac.step')
     def step(
         self,
         state: DistKFACState,
@@ -1083,7 +1208,15 @@ class DistributedKFAC:
             lambda s: s,
             state,
         )
-        new_grads = self.precondition(state, grads)
+        if cfg.metrics is not None and state.metrics is not None:
+            scal: dict[str, jax.Array] = {}
+            new_grads = self.precondition(state, grads, metrics_out=scal)
+            ms = metrics_lib.update_scalars(state.metrics, scal)
+            state = state._replace(
+                metrics=metrics_lib.finalize(ms, cfg.metrics, state.step)
+            )
+        else:
+            new_grads = self.precondition(state, grads)
         state = state._replace(step=state.step + 1)
         return state, new_grads
 
@@ -1173,6 +1306,18 @@ class DistributedKFAC:
                 f'{len(b.layers)} layers, {b.padded} padded slots'
             )
         lines.append(
+            'factor storage fill (resident vs padding bytes per size '
+            'class):'
+        )
+        for key, p in comms_lib.padding_report(self).items():
+            lines.append(
+                f'  {key}: {p["layers"]} layers in {p["slots"]} slots, '
+                f'resident {p["resident_bytes"]} B, '
+                f'identity-pad {p["identity_pad_bytes"]} B, '
+                f'slot-pad {p["slot_pad_bytes"]} B, '
+                f'fill {p["fill"]:.0%}'
+            )
+        lines.append(
             'executed placement (slot round-robin within stacked buckets; '
             'decomposition runs where the slot lives):'
         )
@@ -1216,7 +1361,17 @@ class DistributedKFAC:
 
         return _np.asarray(self.mesh.devices).reshape(-1)[i // spd]
 
-    def memory_usage(self, state: DistKFACState) -> dict[str, int]:
+    def comms_report(self) -> dict[str, Any]:
+        """Host-side comms/padding byte accounting for this configuration.
+
+        See :func:`kfac_tpu.observability.comms.comms_summary`: stat
+        transport bytes and chunk plan, inverse-reshard and
+        gradient-broadcast payloads, and per-size-class padding waste —
+        the measurable side of the KAISA gradient-worker-fraction trade.
+        """
+        return comms_lib.comms_summary(self)
+
+    def memory_usage(self, state: DistKFACState) -> dict[str, Any]:
         """Per-device bytes by category, read from the ACTUAL shard layout.
 
         Each array's per-device footprint is its sharding's shard shape —
@@ -1224,6 +1379,12 @@ class DistributedKFAC:
         arithmetic from the strategy (VERDICT round 1: estimates mislead on
         asymmetric layouts). Falls back to strategy fractions only for
         abstract values (e.g. under trace).
+
+        ``total`` sums the four factor/inverse categories;
+        ``padding_waste`` (nested, GLOBAL logical bytes — not per-device)
+        breaks resident factor bytes out of the size-class padding, per
+        storage bucket plus totals, so the cost of bucket granularity is
+        visible next to the resident footprint.
         """
         shard_f = 1.0 / self.total_devices
         if self.strategy == enums.DistributedStrategy.COMM_OPT:
@@ -1256,4 +1417,14 @@ class DistributedKFAC:
             + nbytes(state.dgda, shard_d) + nbytes(state.g_inv, shard_d),
         }
         sizes['total'] = sum(sizes.values())
+        padding = comms_lib.padding_report(self)
+        sizes['padding_waste'] = {
+            'per_class': padding,
+            'resident_bytes': sum(
+                p['resident_bytes'] for p in padding.values()),
+            'identity_pad_bytes': sum(
+                p['identity_pad_bytes'] for p in padding.values()),
+            'slot_pad_bytes': sum(
+                p['slot_pad_bytes'] for p in padding.values()),
+        }
         return sizes

@@ -28,9 +28,11 @@ import jax.numpy as jnp
 
 from kfac_tpu import enums
 from kfac_tpu import health as health_lib
+from kfac_tpu import tracing
 from kfac_tpu import warnings as kfac_warnings
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
+from kfac_tpu.observability import metrics as metrics_lib
 from kfac_tpu.ops import factors as factors_lib
 
 ScalarOrSchedule = float | Callable[[jax.Array], jax.Array | float]
@@ -79,6 +81,10 @@ class KFACState(NamedTuple):
     ``health``: :class:`kfac_tpu.health.HealthState` counters when the
     numerical-health sentinel is enabled, else ``None`` (an empty pytree
     subtree — zero state, zero cost).
+    ``metrics``: :class:`kfac_tpu.observability.MetricsState` per-layer
+    telemetry scalars when metrics are enabled, else ``None`` — same
+    contract as ``health``: ephemeral (not checkpointed; rebuilt by
+    ``init``), zero cost when off.
     Unused method slots hold empty dicts so the pytree structure is static
     per-configuration.
     """
@@ -94,6 +100,7 @@ class KFACState(NamedTuple):
     a_inv: dict[str, jax.Array]
     g_inv: dict[str, jax.Array]
     health: Any = None
+    metrics: Any = None
 
 
 @dataclasses.dataclass
@@ -214,8 +221,28 @@ class KFACPreconditioner:
     # health.HealthConfig to tune thresholds. Honored by both engines and
     # by Trainer's skip-step gate.
     health: health_lib.HealthConfig | bool | None = None
+    # In-jit per-layer telemetry (kfac_tpu/observability,
+    # docs/OBSERVABILITY.md): grad/preconditioned-grad norms, kl_clip
+    # scale, effective damping, Gershgorin factor bounds, and
+    # factor/inverse staleness, computed inside the jitted step and
+    # drained host-side with observability.MetricsCollector. None disables
+    # (zero state, zero cost); True enables MetricsConfig defaults; or
+    # pass an observability.MetricsConfig to select scalar families.
+    # Honored by both engines.
+    metrics: 'metrics_lib.MetricsConfig | bool | None' = None
 
     def __post_init__(self) -> None:
+        if self.metrics is True:
+            self.metrics = metrics_lib.MetricsConfig()
+        elif self.metrics is False:
+            self.metrics = None
+        elif self.metrics is not None and not isinstance(
+            self.metrics, metrics_lib.MetricsConfig
+        ):
+            raise TypeError(
+                'metrics must be a MetricsConfig, True, False, or None; '
+                f'got {self.metrics!r}'
+            )
         if self.health is True:
             self.health = health_lib.HealthConfig()
         elif self.health is False:
@@ -390,10 +417,17 @@ class KFACPreconditioner:
                 health_lib.init_health(self.registry.layers)
                 if self.health is not None else None
             ),
+            metrics=(
+                metrics_lib.init_metrics(
+                    self.metrics, list(self.registry.layers)
+                )
+                if self.metrics is not None else None
+            ),
         )
 
     # --------------------------------------------------------------- factors
 
+    @tracing.scope('kfac.update_factors')
     def update_factors(
         self,
         state: KFACState,
@@ -435,46 +469,92 @@ class KFACPreconditioner:
             if n in stats.g else state.g[n]
             for n in state.g
         }
-        if self.health is None:
-            return state._replace(a=new_a, g=new_g)
+        # per-layer acceptance verdicts (health sentinel); layers without a
+        # verdict were accepted unconditionally — the metrics block below
+        # reads this to advance last_factor_step only for accepted updates
+        ok_verdicts: dict[str, jax.Array] = {}
+        new_health = state.health
+        if self.health is not None:
+            # factor quarantine: a non-finite or
+            # quarantine-threshold-violating candidate rolls BOTH of the
+            # layer's factors back to their previous (healthy) values and
+            # escalates the layer's damping multiplier; healthy updates
+            # decay the multiplier back toward 1. Layers not in this
+            # capture (unexecuted) get no verdict — their factors did not
+            # move. The verdict is taken at the layer's EFFECTIVE damping:
+            # an already-escalated layer is judged by the inverse it would
+            # actually compute.
+            cfg = self.health
+            h = state.health
+            damping = _resolve(self.damping, state.step)
+            mult = dict(h.damping_mult)
+            quarantined = dict(h.quarantined)
+            events = dict(h.quarantine_events)
+            for n in state.a:
+                if n not in stats.a and n not in stats.g:
+                    continue
+                eff = damping * h.damping_mult[n]
+                ok = health_lib.factor_ok(
+                    new_a[n], eff, cfg.quarantine_threshold
+                ) & health_lib.factor_ok(
+                    new_g[n], eff, cfg.quarantine_threshold
+                )
+                ok_verdicts[n] = ok
+                new_a[n] = jnp.where(ok, new_a[n], state.a[n])
+                new_g[n] = jnp.where(ok, new_g[n], state.g[n])
+                mult[n], quarantined[n], events[n] = (
+                    health_lib.quarantine_update(
+                        cfg, ok, h.damping_mult[n], h.quarantined[n],
+                        h.quarantine_events[n],
+                    )
+                )
+            new_health = h._replace(
+                damping_mult=mult, quarantined=quarantined,
+                quarantine_events=events,
+            )
+        state = state._replace(a=new_a, g=new_g, health=new_health)
+        if self.metrics is not None and state.metrics is not None:
+            state = state._replace(
+                metrics=self._record_factor_metrics(
+                    state, stats, ok_verdicts
+                )
+            )
+        return state
 
-        # factor quarantine: a non-finite or quarantine-threshold-violating
-        # candidate rolls BOTH of the layer's factors back to their previous
-        # (healthy) values and escalates the layer's damping multiplier;
-        # healthy updates decay the multiplier back toward 1. Layers not in
-        # this capture (unexecuted) get no verdict — their factors did not
-        # move. The verdict is taken at the layer's EFFECTIVE damping: an
-        # already-escalated layer is judged by the inverse it would actually
-        # compute.
-        cfg = self.health
-        h = state.health
-        damping = _resolve(self.damping, state.step)
-        mult = dict(h.damping_mult)
-        quarantined = dict(h.quarantined)
-        events = dict(h.quarantine_events)
+    def _record_factor_metrics(
+        self,
+        state: KFACState,
+        stats: capture_lib.CapturedStats,
+        ok_verdicts: dict[str, jax.Array],
+    ) -> metrics_lib.MetricsState:
+        """Factor-phase telemetry on the POST-rollback factors.
+
+        Gershgorin bounds describe the factors that will actually be
+        decomposed; ``last_factor_step`` advances only for layers whose
+        update this capture touched AND the health sentinel accepted.
+        """
+        mcfg = self.metrics
+        ms = state.metrics
+        scalars: dict[str, jax.Array] = {}
+        touched: dict[str, jax.Array | None] = {}
         for n in state.a:
             if n not in stats.a and n not in stats.g:
                 continue
-            eff = damping * h.damping_mult[n]
-            ok = health_lib.factor_ok(
-                new_a[n], eff, cfg.quarantine_threshold
-            ) & health_lib.factor_ok(new_g[n], eff, cfg.quarantine_threshold)
-            new_a[n] = jnp.where(ok, new_a[n], state.a[n])
-            new_g[n] = jnp.where(ok, new_g[n], state.g[n])
-            mult[n], quarantined[n], events[n] = health_lib.quarantine_update(
-                cfg, ok, h.damping_mult[n], h.quarantined[n],
-                h.quarantine_events[n],
-            )
-        return state._replace(
-            a=new_a, g=new_g,
-            health=h._replace(
-                damping_mult=mult, quarantined=quarantined,
-                quarantine_events=events,
-            ),
-        )
+            if mcfg.factor_bounds:
+                lmin_a, lmax_a = metrics_lib.gershgorin_bounds(state.a[n])
+                lmin_g, lmax_g = metrics_lib.gershgorin_bounds(state.g[n])
+                scalars[f'factor_lmin/a/{n}'] = lmin_a
+                scalars[f'factor_lmax/a/{n}'] = lmax_a
+                scalars[f'factor_lmin/g/{n}'] = lmin_g
+                scalars[f'factor_lmax/g/{n}'] = lmax_g
+            touched[n] = ok_verdicts.get(n)
+        return metrics_lib.update_scalars(ms, scalars)._replace(
+            last_factor_step=metrics_lib.advance_last(
+                ms.last_factor_step, ms.names, touched, state.step))
 
     # -------------------------------------------------------------- inverses
 
+    @tracing.scope('kfac.update_inverses')
     def update_inverses(self, state: KFACState) -> KFACState:
         """Recompute eigendecompositions (or inverses) from current factors.
 
@@ -491,6 +571,7 @@ class KFACPreconditioner:
         cfg = self.health
         h = state.health
         bad_inv = dict(h.bad_inv) if cfg is not None else {}
+        inv_ok: dict[str, jax.Array] = {}
 
         def eff_damping(name):
             if cfg is None:
@@ -521,6 +602,7 @@ class KFACPreconditioner:
                     cand['da'], cand['dg'] = adec.d, gdec.d
                 if cfg is not None:
                     ok = outputs_ok(*cand.values())
+                    inv_ok[name] = ok
                     prev = {
                         'qa': state.qa[name], 'qg': state.qg[name],
                         'dgda': state.dgda.get(name),
@@ -554,6 +636,7 @@ class KFACPreconditioner:
                 cand_g = inv(state.g[name], state.g_inv[name], eff_damping(name))
                 if cfg is not None:
                     ok = outputs_ok(cand_a, cand_g)
+                    inv_ok[name] = ok
                     cand_a = jnp.where(ok, cand_a, state.a_inv[name])
                     cand_g = jnp.where(ok, cand_g, state.g_inv[name])
                     bad_inv[name] = health_lib.inversion_update(
@@ -563,6 +646,12 @@ class KFACPreconditioner:
             state = state._replace(a_inv=a_inv, g_inv=g_inv)
         if cfg is not None:
             state = state._replace(health=h._replace(bad_inv=bad_inv))
+        if self.metrics is not None and state.metrics is not None:
+            ms = state.metrics
+            touched = {n: inv_ok.get(n) for n in self.registry.layers}
+            state = state._replace(metrics=ms._replace(
+                last_inv_step=metrics_lib.advance_last(
+                    ms.last_inv_step, ms.names, touched, state.step)))
         return state
 
     # --------------------------------------------------------- precondition
@@ -589,10 +678,12 @@ class KFACPreconditioner:
             grad_mat, state.a_inv[name], state.g_inv[name]
         )
 
+    @tracing.scope('kfac.precondition')
     def precondition(
         self,
         state: KFACState,
         grads: Any,
+        metrics_out: dict[str, jax.Array] | None = None,
     ) -> Any:
         """Precondition a params-shaped gradient pytree.
 
@@ -600,6 +691,12 @@ class KFACPreconditioner:
         one fused scalar reduction over all layers — no per-layer host syncs
         (cf. reference's ``.item()`` loop,
         kfac/base_preconditioner.py:411-435).
+
+        ``metrics_out``, when given, is filled in-place with this phase's
+        telemetry scalars (grad/preconditioned-grad norms, effective
+        damping, kl_clip scale) — values the preconditioning math already
+        materializes, so collection adds no extra passes; ``step`` merges
+        them into ``state.metrics``.
         """
         damping = _resolve(self.damping, state.step)
         layer_grads = registry_lib.slice_layer_grads(grads, self.registry)
@@ -608,6 +705,7 @@ class KFACPreconditioner:
         lr = _resolve(self.lr, state.step)
         cfg = self.health
         h = state.health
+        mcfg = self.metrics if metrics_out is not None else None
         for name, helper in self.registry.layers.items():
             gmat = helper.grads_to_matrix(layer_grads[name])
             # per-layer escalated damping bites here for the non-prediv
@@ -616,6 +714,13 @@ class KFACPreconditioner:
             eff = (
                 damping * h.damping_mult[name] if cfg is not None else damping
             )
+            if mcfg is not None:
+                if mcfg.grad_norms:
+                    g32 = gmat.astype(jnp.float32)
+                    metrics_out[f'grad_norm/{name}'] = jnp.sqrt(
+                        jnp.sum(g32 * g32))
+                metrics_out[f'damping_eff/{name}'] = jnp.asarray(
+                    eff, jnp.float32)
             pmat = self._precondition_one(state, name, gmat, eff)
             if cfg is not None:
                 # graceful degradation: a layer past degrade_after
@@ -624,6 +729,14 @@ class KFACPreconditioner:
                 # the rest), first-order for this layer only
                 degraded = health_lib.is_degraded(cfg, h.bad_inv[name])
                 pmat = jnp.where(degraded, gmat.astype(pmat.dtype), pmat)
+            if mcfg is not None and mcfg.grad_norms:
+                # pre-scale norm, next to the kl_clip reduction's read of
+                # pmat (one fused pass); the scalar is rescaled by
+                # kl_clip_scale below instead of re-reading the scaled
+                # tensor in the output loop
+                p32 = pmat.astype(jnp.float32)
+                metrics_out[f'precond_grad_norm/{name}'] = jnp.sqrt(
+                    jnp.sum(p32 * p32))
             if self.kl_clip is not None:
                 vg_terms.append(
                     jnp.sum(pmat.astype(jnp.float32) * gmat.astype(jnp.float32))
@@ -637,15 +750,25 @@ class KFACPreconditioner:
             )
         else:
             scale = None
+        if mcfg is not None:
+            metrics_out['kl_clip_scale'] = (
+                scale.astype(jnp.float32) if scale is not None
+                else jnp.ones((), jnp.float32)
+            )
         out: dict[str, dict[str, jax.Array]] = {}
         for name, (pmat, helper) in precond.items():
             if scale is not None:
                 pmat = (pmat.astype(jnp.float32) * scale).astype(pmat.dtype)
+                if mcfg is not None and mcfg.grad_norms:
+                    metrics_out[f'precond_grad_norm/{name}'] = (
+                        metrics_out[f'precond_grad_norm/{name}']
+                        * jnp.abs(scale.astype(jnp.float32)))
             out[name] = helper.matrix_to_grads(pmat)
         return registry_lib.merge_layer_grads(grads, out, self.registry)
 
     # ------------------------------------------------------------------ step
 
+    @tracing.scope('kfac.step')
     def step(
         self,
         state: KFACState,
@@ -674,7 +797,15 @@ class KFACPreconditioner:
             lambda s: s,
             state,
         )
-        new_grads = self.precondition(state, grads)
+        if self.metrics is not None and state.metrics is not None:
+            scal: dict[str, jax.Array] = {}
+            new_grads = self.precondition(state, grads, metrics_out=scal)
+            ms = metrics_lib.update_scalars(state.metrics, scal)
+            state = state._replace(
+                metrics=metrics_lib.finalize(ms, self.metrics, state.step)
+            )
+        else:
+            new_grads = self.precondition(state, grads)
         state = state._replace(step=state.step + 1)
         return state, new_grads
 
@@ -735,6 +866,12 @@ class KFACPreconditioner:
                 f'quarantine_threshold={hc.quarantine_threshold} '
                 f'damping_escalation={hc.damping_escalation} '
                 f'degrade_after={hc.degrade_after}'
+            )
+        if self.metrics is not None:
+            mc = self.metrics
+            lines.append(
+                f'  metrics: grad_norms={mc.grad_norms} '
+                f'factor_bounds={mc.factor_bounds} staleness={mc.staleness}'
             )
         for name, h in self.registry.layers.items():
             lines.append(

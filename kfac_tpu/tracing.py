@@ -21,6 +21,7 @@ import jax
 F = TypeVar('F', bound=Callable[..., Any])
 
 _func_traces: dict[str, list[float]] = {}
+_force_sync: bool = False
 
 logger = logging.getLogger(__name__)
 
@@ -30,12 +31,52 @@ def clear_trace() -> None:
     _func_traces.clear()
 
 
+def force_sync(enabled: bool) -> None:
+    """Globally promote every ``@trace`` call site to ``sync=True``.
+
+    The one-call switch for honest timings: hot paths are decorated with
+    ``sync=False`` (dispatch-only cost, async pipelining preserved);
+    flipping this blocks each traced call on its full output pytree so the
+    recorded times are execution wall times, the role the reference's
+    ``dist.barrier`` plays for honest distributed timings
+    (kfac/tracing.py:82-108). Turn it back off after the measurement.
+    """
+    global _force_sync
+    _force_sync = bool(enabled)
+
+
+def sync_forced() -> bool:
+    """Whether :func:`force_sync` is currently engaged."""
+    return _force_sync
+
+
+def _block_all(out: Any) -> None:
+    """Block on EVERY array leaf of ``out``.
+
+    ``jax.block_until_ready`` historically blocked on only the first leaf
+    jax happened to return for some container types; honest step timing
+    must wait for the whole output pytree (the last collective of a
+    sharded step can trail the first leaf by the entire comms phase), so
+    the sync walks every leaf explicitly.
+    """
+    for leaf in jax.tree_util.tree_leaves(out):
+        block = getattr(leaf, 'block_until_ready', None)
+        if block is not None:
+            block()
+
+
 def trace(sync: bool = False, name: str | None = None) -> Callable[[F], F]:
     """Decorator recording wall times of each call into a global table.
 
+    Each call also runs under ``jax.named_scope`` so the stage is
+    attributable in XLA profiler traces, and the wrapper is stamped with
+    ``__kfac_scope__`` for the named-scope lint
+    (tools/lint_named_scopes.py).
+
     Args:
-        sync: block on the function's jax outputs before stopping the clock
-            (async dispatch otherwise makes times meaningless).
+        sync: block on the function's FULL jax output pytree before
+            stopping the clock (async dispatch otherwise makes times
+            meaningless). :func:`force_sync` promotes every call site.
         name: override the recorded name (defaults to the function name).
     """
 
@@ -47,11 +88,34 @@ def trace(sync: bool = False, name: str | None = None) -> Callable[[F], F]:
             start = time.perf_counter()
             with jax.named_scope(key):
                 out = func(*args, **kwargs)
-            if sync:
-                out = jax.block_until_ready(out)
+            if sync or _force_sync:
+                _block_all(out)
             _func_traces.setdefault(key, []).append(time.perf_counter() - start)
             return out
 
+        wrapped.__kfac_scope__ = key  # type: ignore[attr-defined]
+        return wrapped  # type: ignore[return-value]
+
+    return decorator
+
+
+def scope(name: str) -> Callable[[F], F]:
+    """``jax.named_scope``-only decorator for in-jit hot paths.
+
+    Engine methods run inside a jitted step: a wall clock there measures
+    trace time, not execution, so they get profiler attribution without
+    the timing table (the Trainer's host-side dispatch paths use
+    :func:`trace`). The marker attribute feeds the same lint as
+    :func:`trace`.
+    """
+
+    def decorator(func: F) -> F:
+        @functools.wraps(func)
+        def wrapped(*args: Any, **kwargs: Any):
+            with jax.named_scope(name):
+                return func(*args, **kwargs)
+
+        wrapped.__kfac_scope__ = name  # type: ignore[attr-defined]
         return wrapped  # type: ignore[return-value]
 
     return decorator

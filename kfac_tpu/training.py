@@ -23,6 +23,7 @@ import jax
 import optax
 
 from kfac_tpu import health as health_lib
+from kfac_tpu import tracing
 from kfac_tpu.layers import capture as capture_lib
 
 
@@ -226,13 +227,23 @@ class Trainer:
         if hc is not None and hc.warn:
             self.check_health(state)
 
+    @tracing.trace(name='trainer/step')
     def step(self, state: TrainState, batch) -> tuple[TrainState, jax.Array]:
-        """One optimization step; picks the capture variant on cadence."""
+        """One optimization step; picks the capture variant on cadence.
+
+        Recorded in the tracing table as ``trainer/step`` (dispatch cost
+        only unless ``tracing.force_sync`` is on) and annotated with
+        ``jax.profiler.StepTraceAnnotation`` so profiler captures group
+        device activity per training step.
+        """
         self._sync_step_count(state)
-        if self.kfac is not None and self._capture_now():
-            out = self._jit_with_stats(state, batch)
-        else:
-            out = self._jit_no_stats(state, batch)
+        with jax.profiler.StepTraceAnnotation(
+            'train', step_num=self._step_count
+        ):
+            if self.kfac is not None and self._capture_now():
+                out = self._jit_with_stats(state, batch)
+            else:
+                out = self._jit_no_stats(state, batch)
         self._step_count += 1
         self._maybe_warn(out[0])
         return out
@@ -316,6 +327,7 @@ class Trainer:
         new_state = self._finish_step(state, grads, stats, new_ms, loss=loss)
         return new_state, loss
 
+    @tracing.trace(name='trainer/scan_steps')
     def scan_steps(
         self, state: TrainState, batches
     ) -> tuple[TrainState, jax.Array]:
@@ -462,6 +474,7 @@ class Trainer:
         self._maybe_warn(new_state)
         return new_state, loss
 
+    @tracing.trace(name='trainer/step_accumulate')
     def step_accumulate(
         self, state: TrainState, microbatches
     ) -> tuple[TrainState, jax.Array]:
@@ -487,6 +500,7 @@ class Trainer:
             self.accumulate_microbatch(state, mb)
         return self.apply_accumulated(state)
 
+    @tracing.trace(name='trainer/step_accumulate_scan')
     def step_accumulate_scan(
         self, state: TrainState, microbatches
     ) -> tuple[TrainState, jax.Array]:

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Lint: every public jitted engine entry point carries a named scope.
+
+The observability spine (docs/OBSERVABILITY.md) relies on the engines'
+hot paths being wrapped in ``jax.named_scope`` — that is what makes XLA
+profiler captures attribute device time to K-FAC phases. Both
+``kfac_tpu.tracing.trace`` and ``kfac_tpu.tracing.scope`` stamp a
+``__kfac_scope__`` attribute on the functions they wrap; this script
+asserts the attribute is present on every entry point below so a
+refactor cannot silently drop the annotation.
+
+Run via ``make obs`` (CPU-pinned) or directly:
+
+    JAX_PLATFORMS=cpu python tools/lint_named_scopes.py
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import sys
+
+# (module, class, methods that must carry __kfac_scope__)
+TARGETS: list[tuple[str, str, tuple[str, ...]]] = [
+    (
+        'kfac_tpu.preconditioner',
+        'KFACPreconditioner',
+        ('step', 'update_factors', 'update_inverses', 'precondition'),
+    ),
+    (
+        'kfac_tpu.parallel.kaisa',
+        'DistributedKFAC',
+        ('step', 'update_factors', 'update_inverses', 'precondition'),
+    ),
+    (
+        'kfac_tpu.training',
+        'Trainer',
+        ('step', 'scan_steps', 'step_accumulate', 'step_accumulate_scan'),
+    ),
+]
+
+
+def check() -> list[str]:
+    """Return a list of 'module.Class.method' strings missing a scope."""
+    missing: list[str] = []
+    for mod_name, cls_name, methods in TARGETS:
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        for meth in methods:
+            # getattr_static avoids triggering descriptors/binding; the
+            # decorators stamp the underlying function object.
+            fn = inspect.getattr_static(cls, meth)
+            fn = getattr(fn, '__func__', fn)
+            if not getattr(fn, '__kfac_scope__', None):
+                missing.append(f'{mod_name}.{cls_name}.{meth}')
+    return missing
+
+
+def main() -> int:
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    # the repo is not pip-installed; make `python tools/...` work from root
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    missing = check()
+    if missing:
+        print('missing named scopes (tracing.trace/tracing.scope):')
+        for m in missing:
+            print(f'  {m}')
+        return 1
+    n = sum(len(m) for _, _, m in TARGETS)
+    print(f'named-scope lint ok: {n} entry points annotated')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
