@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults obs bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults obs inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -37,11 +37,21 @@ test-integration:
 faults:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m faults
 
-# telemetry spine: observability test suite + named-scope lint
-# (see docs/OBSERVABILITY.md)
+# telemetry spine: observability + flight-recorder test suites, the
+# named-scope and metric-key-schema lints, and the kfac_inspect
+# analysis selftest (see docs/OBSERVABILITY.md)
 obs:
-	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py -q
+	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py \
+		tests/test_flight_recorder.py -q
 	$(TEST_ENV) $(PY) tools/lint_named_scopes.py
+	$(TEST_ENV) $(PY) tools/lint_metric_keys.py
+	$(PY) tools/kfac_inspect.py --selftest
+
+# offline triage: divergence timeline from a metrics JSONL or a
+# flight-recorder postmortem bundle directory
+#   make inspect BUNDLE=postmortems/postmortem-step00000042-skip
+inspect:
+	$(PY) tools/kfac_inspect.py $(BUNDLE)
 
 bench:
 	$(PY) bench.py

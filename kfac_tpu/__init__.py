@@ -12,7 +12,12 @@ from kfac_tpu import compat  # noqa: F401  (installs JAX API shims first)
 from kfac_tpu import checkpoint, enums, health, hyperparams, tracing, warnings
 from kfac_tpu import observability
 from kfac_tpu.health import HealthConfig, HealthState
-from kfac_tpu.observability import MetricsCollector, MetricsConfig
+from kfac_tpu.observability import (
+    FlightRecorderConfig,
+    MetricsCollector,
+    MetricsConfig,
+    PostmortemWriter,
+)
 from kfac_tpu.preconditioner import default_compute_method
 from kfac_tpu.enums import (
     AllreduceMethod,
@@ -38,12 +43,14 @@ __all__ = [
     'ComputeMethod',
     'CurvatureCapture',
     'DistributedStrategy',
+    'FlightRecorderConfig',
     'HealthConfig',
     'HealthState',
     'KFACPreconditioner',
     'KFACState',
     'MetricsCollector',
     'MetricsConfig',
+    'PostmortemWriter',
     'Registry',
     'health',
     'TrainState',

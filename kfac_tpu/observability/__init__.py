@@ -1,9 +1,13 @@
-"""Telemetry spine: in-jit metrics, sinks, profiler, and comms accounting.
+"""Telemetry spine: in-jit metrics, flight recorder, sinks, profiler, comms.
 
-Four small modules, one per concern:
+Five small modules, one per concern:
 
 - :mod:`kfac_tpu.observability.metrics` — the in-jit per-layer scalar
   state threaded through both engines and the one-``device_get`` drain.
+- :mod:`kfac_tpu.observability.flight_recorder` — fixed-capacity
+  on-device ring buffer of the last N steps' scalars + loss + grad norm,
+  cross-host skew aggregation at drain time, and the health-triggered
+  :class:`PostmortemWriter` bundle sink.
 - :mod:`kfac_tpu.observability.sinks` — JSONL writer and rate-limited
   logging adapter for the drained records.
 - :mod:`kfac_tpu.observability.profiler` — XLA profiler session helpers
@@ -11,14 +15,22 @@ Four small modules, one per concern:
 - :mod:`kfac_tpu.observability.comms` — host-side byte accounting for
   the KAISA transports and size-class padding waste.
 
-See docs/OBSERVABILITY.md for the metric-key schema and quickstarts.
+See docs/OBSERVABILITY.md for the metric-key schema, flight-recorder
+sizing guidance, the postmortem bundle layout, and quickstarts.
 """
 
 from kfac_tpu.observability import comms
+from kfac_tpu.observability import flight_recorder
 from kfac_tpu.observability import metrics
 from kfac_tpu.observability import profiler
 from kfac_tpu.observability import sinks
 from kfac_tpu.observability.comms import comms_summary
+from kfac_tpu.observability.flight_recorder import (
+    FlightRecorderConfig,
+    FlightRecorderState,
+    PostmortemWriter,
+    drain_flight,
+)
 from kfac_tpu.observability.metrics import (
     MetricsCollector,
     MetricsConfig,
@@ -33,14 +45,19 @@ from kfac_tpu.observability.profiler import (
 from kfac_tpu.observability.sinks import JSONLWriter, RateLimitedLogger
 
 __all__ = [
+    'FlightRecorderConfig',
+    'FlightRecorderState',
     'JSONLWriter',
     'MetricsCollector',
     'MetricsConfig',
     'MetricsState',
+    'PostmortemWriter',
     'RateLimitedLogger',
     'capture_steps',
     'comms',
     'comms_summary',
+    'drain_flight',
+    'flight_recorder',
     'metric_keys',
     'metrics',
     'profile_session',

@@ -263,9 +263,17 @@ class MetricsCollector:
         self,
         include_health: bool = True,
         include_trace: bool = False,
+        trace_max_history: int | None = 256,
     ) -> None:
         self.include_health = include_health
         self.include_trace = include_trace
+        # the tracing table grows one entry per traced call for the life
+        # of the process; averaging the FULL history both skews time/*
+        # toward ancient steps (a warm-up compile forever dominates) and
+        # makes drain cost grow with run length, so the fold-in reads a
+        # bounded most-recent window by default. None = unbounded (the
+        # old behavior).
+        self.trace_max_history = trace_max_history
 
     def drain(self, state: Any) -> dict[str, Any]:
         """Snapshot ``state``'s telemetry as a flat JSON-friendly dict.
@@ -291,6 +299,9 @@ class MetricsCollector:
             record.update(tracing.health_counters(kstate))
         if self.include_trace:
             from kfac_tpu import tracing
-            for key, seconds in tracing.get_trace(average=True).items():
+            trace = tracing.get_trace(
+                average=True, max_history=self.trace_max_history
+            )
+            for key, seconds in trace.items():
                 record[f'time/{key}'] = seconds
         return record

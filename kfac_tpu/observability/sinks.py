@@ -37,6 +37,12 @@ class JSONLWriter:
 
     def __init__(self, path: str | os.PathLike[str], append: bool = True):
         self.path = os.fspath(path)
+        # telemetry paths are routinely dated subdirectories that don't
+        # exist yet (runs/2024-01-01/metrics.jsonl); create them instead
+        # of failing the first write of an otherwise healthy run
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         self._file: IO[str] | None = open(self.path, 'a' if append else 'w')
 
     def write(self, record: dict[str, Any]) -> None:
@@ -49,7 +55,11 @@ class JSONLWriter:
         self._file.flush()
 
     def close(self) -> None:
+        # flush-before-close ordering is explicit (not left to close()'s
+        # implicit flush) so every record written is durable on disk by
+        # the time close returns, even for exotic IO objects
         if self._file is not None:
+            self._file.flush()
             self._file.close()
             self._file = None
 

@@ -41,6 +41,7 @@ from kfac_tpu import tracing
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
 from kfac_tpu.observability import comms as comms_lib
+from kfac_tpu.observability import flight_recorder as flight_lib
 from kfac_tpu.observability import metrics as metrics_lib
 from kfac_tpu.ops import factors as factors_lib
 from kfac_tpu.parallel import collectives
@@ -201,6 +202,11 @@ class DistKFACState(NamedTuple):
     telemetry when metrics are enabled, else ``None``. Like ``health``,
     layer-keyed replicated scalars — the same drained schema as the dense
     engine, layout-independent.
+
+    ``flight``: :class:`kfac_tpu.observability.FlightRecorderState`
+    rolling telemetry ring when the flight recorder is enabled, else
+    ``None``. Replicated (small fixed-size buffers, layout-independent);
+    same ephemeral contract as ``metrics``.
     """
 
     step: jax.Array
@@ -216,6 +222,7 @@ class DistKFACState(NamedTuple):
     inv_damping: jax.Array
     health: Any = None
     metrics: Any = None
+    flight: Any = None
 
 
 @dataclasses.dataclass
@@ -367,6 +374,19 @@ class DistributedKFAC:
             )
         else:
             metrics_sh = None
+        if self.config.flight is not None:
+            keys = tuple(metrics_lib.metric_keys(
+                self.config.metrics, list(self.registry.layers)))
+            flight_sh = flight_lib.FlightRecorderState(
+                keys=keys,
+                steps=rep,
+                loss=rep,
+                loss_valid=rep,
+                grad_norm=rep,
+                scalars=rep,
+            )
+        else:
+            flight_sh = None
         return DistKFACState(
             step=rep,
             a=adict(fac),
@@ -381,6 +401,7 @@ class DistributedKFAC:
             inv_damping=rep,
             health=health_sh,
             metrics=metrics_sh,
+            flight=flight_sh,
         )
 
     # ----------------------------------------------------------------- init
@@ -443,6 +464,15 @@ class DistributedKFAC:
                         cfg.metrics, list(self.registry.layers)
                     )
                     if cfg.metrics is not None else None
+                ),
+                flight=(
+                    flight_lib.init_flight(
+                        cfg.flight,
+                        metrics_lib.metric_keys(
+                            cfg.metrics, list(self.registry.layers)
+                        ),
+                    )
+                    if cfg.flight is not None else None
                 ),
             )
 
@@ -1191,9 +1221,11 @@ class DistributedKFAC:
         state: DistKFACState,
         grads: Any,
         stats: capture_lib.CapturedStats | None,
+        loss: jax.Array | None = None,
     ) -> tuple[DistKFACState, Any]:
         """One KAISA step (same pipeline as the dense engine,
-        kfac_tpu/preconditioner.py:step)."""
+        kfac_tpu/preconditioner.py:step). ``loss``, when given, rides
+        into the flight-recorder ring next to this step's scalars."""
         cfg = self.config
         if stats is not None:
             state = jax.lax.cond(
@@ -1217,6 +1249,16 @@ class DistributedKFAC:
             )
         else:
             new_grads = self.precondition(state, grads)
+        if cfg.flight is not None and state.flight is not None:
+            # same placement as the dense engine: after finalize, so the
+            # ring row equals what a collector drain would read this step
+            state = state._replace(flight=flight_lib.record(
+                state.flight,
+                state.step,
+                state.metrics.scalars,
+                loss=loss,
+                grad_norm=flight_lib.global_grad_norm(grads),
+            ))
         state = state._replace(step=state.step + 1)
         return state, new_grads
 

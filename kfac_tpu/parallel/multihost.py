@@ -101,6 +101,25 @@ def hybrid_kaisa_mesh(
     return Mesh(grid, (mesh_lib.GW_AXIS, mesh_lib.COL_AXIS))
 
 
+def allgather_scalars(values: np.ndarray | Sequence[float]) -> np.ndarray:
+    """All-gather a small host-local float array across processes.
+
+    Returns a ``(process_count, *values.shape)`` numpy array ordered by
+    process index. Single-process this is a pure-numpy reshape (no device
+    work at all); multi-host it is one fixed-shape
+    ``multihost_utils.process_allgather`` — callers (the flight-recorder
+    drain's skew columns) batch everything they need into ONE call so a
+    drain costs at most one DCN collective. Every process must call this
+    with an identically-shaped array (SPMD symmetry).
+    """
+    arr = np.asarray(values, np.float32)
+    if jax.process_count() == 1:
+        return arr[None, ...]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
 def process_count() -> int:
     return jax.process_count()
 

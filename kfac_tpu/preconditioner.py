@@ -32,6 +32,7 @@ from kfac_tpu import tracing
 from kfac_tpu import warnings as kfac_warnings
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
+from kfac_tpu.observability import flight_recorder as flight_lib
 from kfac_tpu.observability import metrics as metrics_lib
 from kfac_tpu.ops import factors as factors_lib
 
@@ -85,6 +86,9 @@ class KFACState(NamedTuple):
     telemetry scalars when metrics are enabled, else ``None`` — same
     contract as ``health``: ephemeral (not checkpointed; rebuilt by
     ``init``), zero cost when off.
+    ``flight``: :class:`kfac_tpu.observability.FlightRecorderState`
+    rolling last-N-step telemetry ring when the flight recorder is
+    enabled, else ``None`` — same ephemeral contract as ``metrics``.
     Unused method slots hold empty dicts so the pytree structure is static
     per-configuration.
     """
@@ -101,6 +105,7 @@ class KFACState(NamedTuple):
     g_inv: dict[str, jax.Array]
     health: Any = None
     metrics: Any = None
+    flight: Any = None
 
 
 @dataclasses.dataclass
@@ -230,6 +235,18 @@ class KFACPreconditioner:
     # pass an observability.MetricsConfig to select scalar families.
     # Honored by both engines.
     metrics: 'metrics_lib.MetricsConfig | bool | None' = None
+    # Flight recorder (kfac_tpu/observability/flight_recorder.py,
+    # docs/OBSERVABILITY.md): fixed-capacity on-device ring buffer
+    # recording the last N steps of the metric scalar schema plus loss
+    # and global grad norm, written in-jit (no host syncs, no
+    # recompilation); drained with observability.drain_flight and
+    # consumed by observability.PostmortemWriter / tools/kfac_inspect.py.
+    # None disables; True enables FlightRecorderConfig defaults; an int
+    # is a capacity shorthand; or pass a FlightRecorderConfig. Enabling
+    # it auto-enables `metrics` (the ring records that schema). Honored
+    # by both engines and all Trainer step paths (the Trainer supplies
+    # the loss).
+    flight: 'flight_lib.FlightRecorderConfig | bool | int | None' = None
 
     def __post_init__(self) -> None:
         if self.metrics is True:
@@ -243,6 +260,28 @@ class KFACPreconditioner:
                 'metrics must be a MetricsConfig, True, False, or None; '
                 f'got {self.metrics!r}'
             )
+        if self.flight is True:
+            self.flight = flight_lib.FlightRecorderConfig()
+        elif self.flight is False:
+            self.flight = None
+        elif isinstance(self.flight, int) and not isinstance(
+            self.flight, bool
+        ):
+            self.flight = flight_lib.FlightRecorderConfig(
+                capacity=self.flight
+            )
+        elif self.flight is not None and not isinstance(
+            self.flight, flight_lib.FlightRecorderConfig
+        ):
+            raise TypeError(
+                'flight must be a FlightRecorderConfig, True, False, an '
+                f'int capacity, or None; got {self.flight!r}'
+            )
+        if self.flight is not None and self.metrics is None:
+            # the ring records the metric scalar schema; an empty schema
+            # would make it a loss-only recorder, which is never what a
+            # flight=True caller wants
+            self.metrics = metrics_lib.MetricsConfig()
         if self.health is True:
             self.health = health_lib.HealthConfig()
         elif self.health is False:
@@ -422,6 +461,15 @@ class KFACPreconditioner:
                     self.metrics, list(self.registry.layers)
                 )
                 if self.metrics is not None else None
+            ),
+            flight=(
+                flight_lib.init_flight(
+                    self.flight,
+                    metrics_lib.metric_keys(
+                        self.metrics, list(self.registry.layers)
+                    ),
+                )
+                if self.flight is not None else None
             ),
         )
 
@@ -774,6 +822,7 @@ class KFACPreconditioner:
         state: KFACState,
         grads: Any,
         stats: capture_lib.CapturedStats | None,
+        loss: jax.Array | None = None,
     ) -> tuple[KFACState, Any]:
         """One K-FAC step: maybe update factors/inverses, precondition grads.
 
@@ -783,6 +832,10 @@ class KFACPreconditioner:
         Passing ``stats=None`` skips factor updates statically — use when the
         training loop compiles a separate no-capture variant for off-cadence
         steps (cheaper forward).
+
+        ``loss``, when given, is recorded in the flight-recorder ring
+        next to this step's scalars (the Trainer passes it on every
+        path); without one the ring slot's loss is marked invalid.
         """
         if stats is not None:
             state = jax.lax.cond(
@@ -806,6 +859,16 @@ class KFACPreconditioner:
             )
         else:
             new_grads = self.precondition(state, grads)
+        if self.flight is not None and state.flight is not None:
+            # one dynamic-index slot write AFTER finalize, so the ring row
+            # holds exactly what a collector drain would see for this step
+            state = state._replace(flight=flight_lib.record(
+                state.flight,
+                state.step,
+                state.metrics.scalars,
+                loss=loss,
+                grad_norm=flight_lib.global_grad_norm(grads),
+            ))
         state = state._replace(step=state.step + 1)
         return state, new_grads
 

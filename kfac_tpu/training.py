@@ -17,6 +17,7 @@ per step).
 from __future__ import annotations
 
 import dataclasses
+import inspect
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -69,6 +70,13 @@ class Trainer:
         # lax.cond cadence instead of silently freezing factor updates
         # (host picks no-stats variant while device cond expects stats).
         self._step_count: int | None = None
+        # whether the preconditioner's step accepts the loss (for the
+        # flight-recorder ring); duck-typed so engine objects with the
+        # bare (state, grads, stats) signature keep working unchanged
+        self._kfac_takes_loss = (
+            self.kfac is not None
+            and 'loss' in inspect.signature(self.kfac.step).parameters
+        )
         if self.kfac is not None:
             if self.registry is None:
                 self.registry = self.kfac.config.registry if hasattr(
@@ -126,7 +134,14 @@ class Trainer:
         """
 
         def apply(_):
-            kstate, pgrads = self.kfac.step(state.kfac_state, grads, stats)
+            if loss is not None and self._kfac_takes_loss:
+                kstate, pgrads = self.kfac.step(
+                    state.kfac_state, grads, stats, loss=loss
+                )
+            else:
+                kstate, pgrads = self.kfac.step(
+                    state.kfac_state, grads, stats
+                )
             params, opt_state, model_state = self._apply_update(
                 state, pgrads, new_model_state
             )
@@ -461,14 +476,15 @@ class Trainer:
             if acc['capture']
             else None
         )
+        loss = acc['loss'] / n
         new_state = self._jit_apply_kfac(
             state,
             grads_avg,
             stats_avg,
             acc['model_state'],
+            loss,
             with_stats=acc['capture'],
         )
-        loss = acc['loss'] / n
         self._accum = None
         self._step_count += 1
         self._maybe_warn(new_state)
@@ -578,13 +594,17 @@ class Trainer:
         return out
 
     def _apply_accumulated(
-        self, state: TrainState, grads, stats, new_model_state, with_stats
+        self, state: TrainState, grads, stats, new_model_state, loss,
+        with_stats,
     ):
         # a single poisoned micro-batch propagates NaN into the summed
         # grads, so the skip-step gate inside _finish_step drops the whole
-        # accumulated batch (and its model_state) in one decision
+        # accumulated batch (and its model_state) in one decision; the
+        # averaged loss rides along for the skip gate's finiteness check
+        # and the flight-recorder ring
         return self._finish_step(
-            state, grads, stats if with_stats else None, new_model_state
+            state, grads, stats if with_stats else None, new_model_state,
+            loss=loss,
         )
 
 

@@ -1,0 +1,550 @@
+"""Flight recorder tests: ring contract, postmortem bundles, triage CLI.
+
+Pins the PR's acceptance criteria: the in-jit ring records the last N
+steps on both engines (both KAISA stat transports) and via all four
+Trainer paths with ZERO added recompilations after step 1 (the
+``_cache_size() == 1`` checks mirror tests/test_observability.py),
+skipped steps leave gaps rather than rows, an injected fault produces
+exactly one complete bundle per health event, and
+``tools/kfac_inspect.py`` parses a bundle back into a correct
+first-bad-layer divergence timeline.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import kfac_tpu
+from kfac_tpu import health as health_lib
+from kfac_tpu import tracing, training
+from kfac_tpu.observability import flight_recorder as flight_lib
+from kfac_tpu.observability import sinks
+from kfac_tpu.parallel import multihost
+from testing import faults, models
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), os.pardir, 'tools')
+)
+import kfac_inspect  # noqa: E402
+import lint_metric_keys  # noqa: E402
+
+
+def _dense_setup(**cfg_kw):
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg, **cfg_kw)
+    cap = kfac_tpu.CurvatureCapture(reg)
+    run = cap.value_stats_and_grad(models.mse_loss(m))
+    return m, params, (x, y), reg, kfac, run
+
+
+def _trainer_setup(**cfg_kw):
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg, **cfg_kw)
+
+    def loss_fn(p, model_state, batch):
+        xx, yy = batch
+        pred = m.apply({'params': p}, xx)
+        return jnp.mean((pred - yy) ** 2), model_state
+
+    trainer = training.Trainer(
+        loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=kfac
+    )
+    return trainer, params, (x, y), reg, kfac
+
+
+# ------------------------------------------------------------------ config
+
+
+def test_flight_config_normalization():
+    reg = _dense_setup()[3]
+    k = kfac_tpu.KFACPreconditioner(registry=reg, flight=True)
+    assert isinstance(k.flight, kfac_tpu.FlightRecorderConfig)
+    assert k.flight.capacity == 64
+    assert k.metrics is not None  # flight auto-enables metrics
+    k = kfac_tpu.KFACPreconditioner(registry=reg, flight=8)
+    assert k.flight.capacity == 8
+    k = kfac_tpu.KFACPreconditioner(registry=reg, flight=False)
+    assert k.flight is None and k.init().flight is None
+    with pytest.raises(TypeError):
+        kfac_tpu.KFACPreconditioner(registry=reg, flight='yes')
+    with pytest.raises(ValueError):
+        kfac_tpu.FlightRecorderConfig(capacity=0)
+    # explicit metrics config is preserved, not overwritten
+    mc = kfac_tpu.MetricsConfig(grad_norms=False)
+    k = kfac_tpu.KFACPreconditioner(registry=reg, flight=4, metrics=mc)
+    assert k.metrics is mc
+
+
+# ------------------------------------------------------------- dense ring
+
+
+def test_ring_records_last_n_dense():
+    """Last-capacity steps survive, chronological, with loss and grad
+    norm; one compiled program serves every step."""
+    _, params, batch, reg, kfac, run = _dense_setup(flight=4)
+    state = kfac.init()
+    assert state.flight is not None and state.flight.capacity == 4
+    step = jax.jit(kfac.step)
+    for i in range(6):
+        (_, _), grads, stats = run(params, batch)
+        state, _ = step(state, grads, stats, loss=jnp.float32(10.0 + i))
+    assert step._cache_size() == 1
+    recs = flight_lib.drain_flight(state)
+    assert [r['step'] for r in recs] == [2, 3, 4, 5]
+    assert [r['loss'] for r in recs] == [12.0, 13.0, 14.0, 15.0]
+    keys = set(kfac_tpu.observability.metric_keys(
+        kfac.metrics, list(reg.layers)))
+    for r in recs:
+        assert keys <= set(r)
+        assert r['process_index'] == 0
+        assert r['grad_norm'] > 0 and np.isfinite(r['grad_norm'])
+    # ring rows equal the collector's view of the same step
+    final = kfac_tpu.MetricsCollector(include_health=False).drain(state)
+    last = recs[-1]
+    for k in keys:
+        np.testing.assert_allclose(last[k], final[k], rtol=1e-6)
+
+
+def test_ring_loss_optional():
+    """Engine steps without a Trainer loss mark the slot loss-invalid
+    (no placeholder zeros that could fake-trigger postmortems)."""
+    _, params, batch, _, kfac, run = _dense_setup(flight=4)
+    state = kfac.init()
+    (_, _), grads, stats = run(params, batch)
+    state, _ = jax.jit(kfac.step)(state, grads, stats)
+    recs = flight_lib.drain_flight(state)
+    assert len(recs) == 1 and 'loss' not in recs[0]
+
+
+def test_global_grad_norm_matches_numpy():
+    tree = {'a': jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            'b': {'c': -jnp.ones((4,), jnp.bfloat16)},
+            'n': jnp.arange(3)}  # integer leaf excluded
+    got = float(flight_lib.global_grad_norm(tree))
+    want = np.sqrt(float(np.sum(np.arange(6.0) ** 2)) + 4.0)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_flight_disabled_by_default():
+    _, params, batch, _, kfac, run = _dense_setup(metrics=True)
+    state = kfac.init()
+    assert state.flight is None
+    assert flight_lib.drain_flight(state) == []
+
+
+def test_skipped_steps_leave_gaps():
+    """The Trainer's skip-step gate writes no slot: the gap IS the
+    signal (and the skip/record cond branches stay structural twins)."""
+    trainer, params, (x, y), _, _ = _trainer_setup(
+        flight=8, health=health_lib.HealthConfig(warn=False))
+    state = trainer.init(params)
+    for _ in range(2):
+        state, _ = trainer.step(state, (x, y))
+    state, _ = trainer.step(state, faults.poison_batch((x, y), kind='nan'))
+    state, _ = trainer.step(state, (x, y))
+    recs = flight_lib.drain_flight(state)
+    assert [r['step'] for r in recs] == [0, 1, 3]
+    assert int(jax.device_get(state.kfac_state.health.skipped_steps)) == 1
+
+
+def test_flight_is_ephemeral_not_checkpointed():
+    from kfac_tpu import checkpoint
+
+    _, params, batch, _, kfac, run = _dense_setup(flight=4)
+    state = kfac.init()
+    (_, _), grads, stats = run(params, batch)
+    state, _ = jax.jit(kfac.step)(state, grads, stats, loss=jnp.float32(2.0))
+    durable = checkpoint.durable_state(state)
+    assert 'flight' not in durable
+    # a fresh init has an empty ring regardless of prior history
+    assert flight_lib.drain_flight(kfac.init()) == []
+
+
+# ------------------------------------------------------------- distributed
+
+
+@pytest.mark.parametrize('transport', ['allreduce', 'allreduce_bucketed'])
+def test_ring_distributed(transport):
+    from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+
+    mesh = kaisa_mesh(grad_worker_fraction=0.5)
+    m = models.TinyModel(hidden=8, out=4)
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=64, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    cfg = kfac_tpu.KFACPreconditioner(
+        registry=reg, flight=4, allreduce_method=transport)
+    dk = DistributedKFAC(config=cfg, mesh=mesh)
+    cap = kfac_tpu.CurvatureCapture(reg)
+    run = cap.value_stats_and_grad(models.mse_loss(m))
+    state = dk.init()
+    step = jax.jit(dk.step)
+    for i in range(5):
+        (_, _), grads, stats = run(params, (x, y))
+        state, _ = step(state, grads, stats, loss=jnp.float32(i))
+    assert step._cache_size() == 1
+    recs = flight_lib.drain_flight(state)
+    assert [r['step'] for r in recs] == [1, 2, 3, 4]
+    assert [r['loss'] for r in recs] == [1.0, 2.0, 3.0, 4.0]
+    expected = set(kfac_tpu.observability.metric_keys(
+        cfg.metrics, list(reg.layers)))
+    assert expected <= set(recs[-1])
+    # every state field has a sharding spec, flight included
+    sh = dk.state_shardings()
+    assert sh.flight is not None
+    assert set(sh._fields) == set(state._fields)
+
+
+def test_distributed_ring_matches_dense():
+    """Same stats in, same ring row out — telemetry parity across
+    engines (mirrors test_distributed_metrics_match_dense)."""
+    from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+
+    mesh = kaisa_mesh(grad_worker_fraction=1.0)
+    m = models.TinyModel(hidden=8, out=4)
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=64, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    cfg = kfac_tpu.KFACPreconditioner(registry=reg, flight=4, damping=0.01)
+    dk = DistributedKFAC(config=cfg, mesh=mesh)
+    cap = kfac_tpu.CurvatureCapture(reg)
+    (_, _), grads, stats = cap.value_stats_and_grad(
+        models.mse_loss(m))(params, (x, y))
+    loss = jnp.float32(3.25)
+
+    ref_state, _ = cfg.step(cfg.init(), grads, stats, loss=loss)
+    dist_state, _ = jax.jit(dk.step)(dk.init(), grads, stats, loss=loss)
+    ref = flight_lib.drain_flight(ref_state)[-1]
+    dist = flight_lib.drain_flight(dist_state)[-1]
+    assert set(ref) == set(dist)
+    for k in ref:
+        np.testing.assert_allclose(ref[k], dist[k], rtol=5e-3, atol=1e-6)
+
+
+# ----------------------------------------------------------- trainer paths
+
+
+def test_trainer_step_and_scan_record_loss():
+    trainer, params, (x, y), _, _ = _trainer_setup(flight=8)
+    state = trainer.init(params)
+    losses = []
+    for _ in range(3):
+        state, loss = trainer.step(state, (x, y))
+        losses.append(float(loss))
+    recs = flight_lib.drain_flight(state)
+    assert [r['step'] for r in recs] == [0, 1, 2]
+    np.testing.assert_allclose([r['loss'] for r in recs], losses, rtol=1e-6)
+
+    trainer, params, (x, y), _, _ = _trainer_setup(flight=8)
+    state = trainer.init(params)
+    state, losses = trainer.scan_steps(
+        state, (jnp.stack([x] * 3), jnp.stack([y] * 3)))
+    recs = flight_lib.drain_flight(state)
+    assert [r['step'] for r in recs] == [0, 1, 2]
+    np.testing.assert_allclose(
+        [r['loss'] for r in recs], np.asarray(losses), rtol=1e-6)
+
+
+def test_trainer_accumulate_paths_record_loss():
+    trainer, params, (x, y), _, _ = _trainer_setup(flight=8)
+    state = trainer.init(params)
+    losses = []
+    for _ in range(2):
+        state, loss = trainer.step_accumulate(state, [(x, y)] * 4)
+        losses.append(float(loss))
+    recs = flight_lib.drain_flight(state)
+    assert [r['step'] for r in recs] == [0, 1]
+    np.testing.assert_allclose([r['loss'] for r in recs], losses, rtol=1e-6)
+
+    trainer, params, (x, y), _, _ = _trainer_setup(flight=8)
+    state = trainer.init(params)
+    losses = []
+    for _ in range(2):
+        state, loss = trainer.step_accumulate_scan(
+            state, (jnp.stack([x] * 4), jnp.stack([y] * 4)))
+        losses.append(float(loss))
+    recs = flight_lib.drain_flight(state)
+    assert [r['step'] for r in recs] == [0, 1]
+    np.testing.assert_allclose([r['loss'] for r in recs], losses, rtol=1e-6)
+
+
+# -------------------------------------------------------------------- skew
+
+
+def test_skew_columns_single_host():
+    """Single-process: skew columns exist and equal the local value (the
+    gather is a pure-numpy no-op)."""
+    _, params, batch, _, kfac, run = _dense_setup(flight=4)
+    state = kfac.init()
+    (_, _), grads, stats = run(params, batch)
+    state, _ = jax.jit(kfac.step)(state, grads, stats, loss=jnp.float32(2.5))
+    rec = flight_lib.drain_flight(state)[-1]
+    for k in ('loss', 'grad_norm', 'kl_clip_scale'):
+        assert rec[f'skew_min/{k}'] == rec[k]
+        assert rec[f'skew_max/{k}'] == rec[k]
+        assert rec[f'skew_mean/{k}'] == rec[k]
+    # skew off: no columns
+    rec = flight_lib.drain_flight(state, skew_keys=None)[-1]
+    assert not any(k.startswith('skew_') for k in rec)
+
+
+def test_allgather_scalars_single_process():
+    mat = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = multihost.allgather_scalars(mat)
+    assert out.shape == (1, 2, 3)
+    np.testing.assert_array_equal(out[0], mat)
+
+
+# -------------------------------------------------------------- postmortem
+# (ride the faults marker: these are the sentinel's fault-injection
+# triggers observed from the telemetry side)
+
+
+@pytest.mark.faults
+def test_postmortem_skip_event_exactly_once(tmp_path):
+    trainer, params, (x, y), _, kfac = _trainer_setup(
+        flight=8, health=health_lib.HealthConfig(warn=False))
+    state = trainer.init(params)
+    pm = kfac_tpu.PostmortemWriter(tmp_path / 'pms', engine=kfac)
+    coll = kfac_tpu.MetricsCollector()
+    for _ in range(3):
+        state, _ = trainer.step(state, (x, y))
+        assert pm.observe(state, coll.drain(state)) is None
+    state, _ = trainer.step(state, faults.poison_batch((x, y), kind='nan'))
+    bundle = pm.observe(state, coll.drain(state))
+    assert bundle is not None and 'skip' in os.path.basename(bundle)
+    # same event seen again -> no second bundle; a NEW skip fires again
+    assert pm.observe(state, coll.drain(state)) is None
+    state, _ = trainer.step(state, (x, y))
+    assert pm.observe(state, coll.drain(state)) is None
+    state, _ = trainer.step(state, faults.poison_batch((x, y), kind='inf'))
+    second = pm.observe(state, coll.drain(state))
+    assert second is not None and second != bundle
+    assert pm.bundles == [bundle, second]
+
+
+@pytest.mark.faults
+def test_postmortem_bundle_complete(tmp_path):
+    trainer, params, (x, y), reg, kfac = _trainer_setup(
+        flight=8, health=health_lib.HealthConfig(warn=False))
+    state = trainer.init(params)
+    for _ in range(2):
+        state, _ = trainer.step(state, (x, y))
+    state, _ = trainer.step(state, faults.poison_batch((x, y)))
+    pm = kfac_tpu.PostmortemWriter(tmp_path / 'pms', engine=kfac)
+    bundle = pm.observe(state)  # writer drains for itself
+    assert bundle is not None
+
+    names = set(os.listdir(bundle))
+    assert {'MANIFEST.json', 'history.npz', 'history.jsonl',
+            'factors.json', 'health.json', 'describe.txt', 'config.json',
+            'fingerprint.json'} <= names
+    man = json.load(open(os.path.join(bundle, 'MANIFEST.json')))
+    assert man['schema'] == flight_lib.BUNDLE_SCHEMA
+    assert man['reason'] == 'skip' and man['process_index'] == 0
+    assert set(man['files']) == names - {'MANIFEST.json'}
+
+    hist = [json.loads(l)
+            for l in open(os.path.join(bundle, 'history.jsonl'))]
+    assert [h['step'] for h in hist] == [0, 1]  # poisoned step skipped
+    npz = np.load(os.path.join(bundle, 'history.npz'))
+    assert list(npz['keys']) == list(
+        kfac_tpu.observability.metric_keys(kfac.metrics, list(reg.layers)))
+    assert npz['scalars'].shape == (8, len(npz['keys']))
+
+    factors = json.load(open(os.path.join(bundle, 'factors.json')))
+    assert set(factors) == set(reg.layers)
+    for entry in factors.values():
+        for side in ('a', 'g'):
+            assert entry[side]['finite'] is True
+            assert entry[side]['gershgorin_lmax'] >= \
+                entry[side]['gershgorin_lmin']
+    health = json.load(open(os.path.join(bundle, 'health.json')))
+    assert health['enabled'] is True and health['skipped_steps'] == 1
+    fp = json.load(open(os.path.join(bundle, 'fingerprint.json')))
+    assert fp['jax'] == jax.__version__ and fp['device_count'] >= 1
+    cfg = json.load(open(os.path.join(bundle, 'config.json')))
+    assert cfg['registry']['layers'] == list(reg.layers)
+    assert cfg['flight']['capacity'] == 8
+
+
+@pytest.mark.faults
+def test_postmortem_quarantine_event(tmp_path):
+    """A poisoned factor stat (grads clean) fires the quarantine trigger."""
+    _, params, batch, _, kfac, run = _dense_setup(
+        flight=8, health=health_lib.HealthConfig(warn=False))
+    state = kfac.init()
+    step = jax.jit(kfac.step)
+    pm = kfac_tpu.PostmortemWriter(tmp_path / 'pms', engine=kfac)
+    (_, _), grads, stats = run(params, batch)
+    state, _ = step(state, grads, stats, loss=jnp.float32(1.0))
+    assert pm.observe(state) is None
+    state, _ = step(state, grads,
+                    faults.poison_stats(stats, 'fc2', side='a'),
+                    loss=jnp.float32(1.0))
+    bundle = pm.observe(state)
+    assert bundle is not None and 'quarantine' in os.path.basename(bundle)
+    health = json.load(open(os.path.join(bundle, 'health.json')))
+    assert health['layers']['fc2']['quarantine_events'] == 1
+
+
+@pytest.mark.faults
+def test_postmortem_max_bundles(tmp_path):
+    trainer, params, (x, y), _, kfac = _trainer_setup(
+        flight=4, health=health_lib.HealthConfig(warn=False))
+    state = trainer.init(params)
+    pm = kfac_tpu.PostmortemWriter(tmp_path / 'pms', engine=kfac,
+                                   max_bundles=1)
+    state, _ = trainer.step(state, faults.poison_batch((x, y)))
+    assert pm.observe(state) is not None
+    state, _ = trainer.step(state, (x, y))
+    state, _ = trainer.step(state, faults.poison_batch((x, y), kind='inf'))
+    assert pm.observe(state) is None  # capped
+    assert len(pm.bundles) == 1
+
+
+# ------------------------------------------------------------ kfac_inspect
+
+
+@pytest.mark.faults
+def test_inspect_roundtrip_names_first_bad_layer(tmp_path, capsys):
+    """Inject a divergence into ONE layer; the bundle round-trips through
+    kfac_inspect into a timeline whose first bad layer is that layer."""
+    _, params, batch, _, kfac, run = _dense_setup(flight=16, health=None)
+    state = kfac.init()
+    step = jax.jit(kfac.step)
+    for i in range(3):
+        (_, _), grads, stats = run(params, batch)
+        state, _ = step(state, grads, stats, loss=jnp.float32(1.0))
+    # fc2's A stats blow up (finite) -> its Gershgorin bound crosses HUGE
+    (_, _), grads, stats = run(params, batch)
+    state, _ = step(state, grads, faults.huge_stats(stats, 'fc2', side='a'),
+                    loss=jnp.float32(2.0))
+    # two steps later the loss goes non-finite -> postmortem trigger
+    (_, _), grads, stats = run(params, batch)
+    state, _ = step(state, grads, stats, loss=jnp.float32(5.0))
+    state, _ = step(state, grads, stats, loss=jnp.float32(np.nan))
+
+    pm = kfac_tpu.PostmortemWriter(tmp_path / 'pms', engine=kfac)
+    bundle = pm.observe(state)
+    assert bundle is not None and 'nonfinite' in os.path.basename(bundle)
+
+    analysis = kfac_inspect.analyze(kfac_inspect.load_bundle(bundle)['history'])
+    fb = analysis['first_bad_layer']
+    assert fb is not None
+    assert fb['layer'] == 'fc2' and fb['step'] == 3
+    assert fb['kind'] == 'huge_factor'
+    kinds = {(e['step'], e['kind']) for e in analysis['events']}
+    assert (5, 'nonfinite_loss') in kinds
+    # the factor summaries agree: fc2's A bound is the huge one
+    factors = json.load(open(os.path.join(bundle, 'factors.json')))
+    assert factors['fc2']['a']['gershgorin_lmax'] >= kfac_inspect.HUGE
+    assert factors['fc1']['a']['gershgorin_lmax'] < kfac_inspect.HUGE
+
+    # CLI smoke: text mode mentions the layer, --json parses
+    assert kfac_inspect.main([bundle]) == 0
+    out = capsys.readouterr().out
+    assert 'first bad layer: fc2' in out
+    assert kfac_inspect.main([bundle, '--json']) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed['first_bad_layer']['layer'] == 'fc2'
+    assert parsed['manifest']['reason'] == 'nonfinite'
+
+
+def test_inspect_reads_collector_jsonl(tmp_path):
+    """The CLI's JSONL mode consumes ordinary MetricsCollector output."""
+    # kl_clip off: on this tiny problem the clip legitimately bites,
+    # which the analyzer would (correctly) flag as a kl_clip_hard event
+    _, params, batch, _, kfac, run = _dense_setup(flight=4, kl_clip=None)
+    state = kfac.init()
+    step = jax.jit(kfac.step)
+    coll = kfac_tpu.MetricsCollector(include_health=False)
+    path = tmp_path / 'metrics.jsonl'
+    with sinks.JSONLWriter(path) as sink:
+        for _ in range(3):
+            (_, _), grads, stats = run(params, batch)
+            state, _ = step(state, grads, stats, loss=jnp.float32(1.0))
+            sink.write(coll.drain(state))
+    analysis = kfac_inspect.analyze(kfac_inspect.load_jsonl(str(path)))
+    assert analysis['n_records'] == 3
+    assert analysis['events'] == [] and analysis['first_bad_layer'] is None
+
+
+def test_inspect_selftest():
+    assert kfac_inspect.selftest() == 0
+
+
+# ------------------------------------------------------------- satellites
+
+
+def test_jsonl_writer_creates_parent_dirs(tmp_path):
+    path = tmp_path / 'runs' / '2026-08-05' / 'metrics.jsonl'
+    with sinks.JSONLWriter(path) as w:
+        w.write({'step': 1})
+    assert json.loads(path.read_text()) == {'step': 1}
+
+
+def test_jsonl_writer_flush_before_close():
+    """close() flushes explicitly BEFORE closing the underlying file."""
+
+    class Spy:
+        def __init__(self):
+            self.calls = []
+
+        def write(self, s):
+            self.calls.append(('write', s))
+
+        def flush(self):
+            self.calls.append(('flush', None))
+
+        def close(self):
+            self.calls.append(('close', None))
+
+    w = sinks.JSONLWriter(os.devnull)
+    spy = w._file = Spy()
+    w.write({'step': 1})
+    w.close()
+    ops = [c[0] for c in spy.calls]
+    assert ops == ['write', 'flush', 'flush', 'close']
+    assert w._file is None
+    with pytest.raises(ValueError):
+        w.write({'step': 2})
+
+
+def test_collector_trace_window_bounded():
+    """include_trace averages a bounded recent window by default, so one
+    ancient outlier (a warm-up compile) can't skew time/* forever."""
+    _, params, batch, _, kfac, run = _dense_setup(metrics=True)
+    state = kfac.init()
+    saved = dict(tracing._func_traces)
+    try:
+        tracing._func_traces.clear()
+        tracing._func_traces['warm'] = [100.0] + [1.0] * 500
+        rec = kfac_tpu.MetricsCollector(
+            include_health=False, include_trace=True).drain(state)
+        assert rec['time/warm'] == 1.0  # default window (256) drops the spike
+        rec = kfac_tpu.MetricsCollector(
+            include_health=False, include_trace=True,
+            trace_max_history=None).drain(state)
+        assert rec['time/warm'] > 1.0  # unbounded: the spike dominates
+    finally:
+        tracing._func_traces.clear()
+        tracing._func_traces.update(saved)
+
+
+def test_metric_key_lint_in_sync():
+    assert lint_metric_keys.check(
+        os.path.join(os.path.dirname(__file__), os.pardir,
+                     'docs', 'OBSERVABILITY.md')) == []
