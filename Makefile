@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults obs inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults obs resilience inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -46,6 +46,13 @@ obs:
 	$(TEST_ENV) $(PY) tools/lint_named_scopes.py
 	$(TEST_ENV) $(PY) tools/lint_metric_keys.py
 	$(PY) tools/kfac_inspect.py --selftest
+
+# preemption-safe training: checkpoint-autopilot suite (includes the
+# slow real-kill subprocess test) and the signal-semantics doc lint
+# (see docs/ROBUSTNESS.md "Preemption & resume")
+resilience:
+	$(TEST_ENV) $(PY) -m pytest tests/test_resilience.py -q
+	$(TEST_ENV) $(PY) tools/lint_signals.py
 
 # offline triage: divergence timeline from a metrics JSONL or a
 # flight-recorder postmortem bundle directory

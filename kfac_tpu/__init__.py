@@ -11,6 +11,8 @@ torch.distributed.
 from kfac_tpu import compat  # noqa: F401  (installs JAX API shims first)
 from kfac_tpu import checkpoint, enums, health, hyperparams, tracing, warnings
 from kfac_tpu import observability
+from kfac_tpu import resilience
+from kfac_tpu.resilience import CheckpointManager, Preempted
 from kfac_tpu.health import HealthConfig, HealthState
 from kfac_tpu.observability import (
     FlightRecorderConfig,
@@ -40,6 +42,7 @@ __all__ = [
     'AllreduceMethod',
     'AssignmentStrategy',
     'CapturedStats',
+    'CheckpointManager',
     'ComputeMethod',
     'CurvatureCapture',
     'DistributedStrategy',
@@ -51,8 +54,10 @@ __all__ = [
     'MetricsCollector',
     'MetricsConfig',
     'PostmortemWriter',
+    'Preempted',
     'Registry',
     'health',
+    'resilience',
     'TrainState',
     'Trainer',
     'checkpoint',

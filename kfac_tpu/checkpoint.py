@@ -44,6 +44,13 @@ def layout_manifest(engine: Any) -> dict[str, Any]:
     cfg = getattr(engine, 'config', engine)
     cm = getattr(cfg, 'compute_method', None)
     man['compute_method'] = getattr(cm, 'name', str(cm))
+    # informational (NOT a layout key: a topology change alone never forces
+    # factor migration — orbax reshards same-layout payloads through the
+    # restore template's shardings); recorded so an elastic restore can
+    # report what it moved between
+    topo = getattr(engine, 'topology', None)
+    if callable(topo):
+        man['topology'] = topo()
     if hasattr(engine, 'a_store'):  # stacked KAISA engine
         man['bucket_granularity'] = int(cfg.bucket_granularity)
         man['colocate_factors'] = bool(cfg.colocate_factors)
@@ -219,6 +226,7 @@ def save(
     extra: dict[str, Any] | None = None,
     engine: Any | None = None,
     wait: bool = True,
+    overwrite: bool = False,
 ) -> Any:
     """Write the durable K-FAC state (plus optional extra trees, e.g. model
     params / optax state) to ``path``.
@@ -238,14 +246,29 @@ def save(
     written only once the checkpoint is DURABLE (at wait time), so a
     manifest's presence always implies a committed checkpoint — a crash
     mid-async-save leaves neither.
+
+    ``overwrite`` controls the policy for a pre-existing ``path``: the
+    default refuses up front (orbax's ``StandardCheckpointer`` would fail
+    anyway, with a less actionable message), ``overwrite=True`` replaces
+    the old checkpoint. Production rotations should prefer fresh
+    step-numbered directories (:class:`kfac_tpu.resilience
+    .CheckpointManager`) so a crashed overwrite can never destroy the
+    only good checkpoint.
     """
     if not _HAS_ORBAX:
         raise RuntimeError('orbax-checkpoint is not available')
+    if not overwrite and '://' not in str(path) and os.path.exists(path):
+        raise ValueError(
+            f'checkpoint path {path!r} already exists; pass '
+            'overwrite=True to replace it, or save each step to a fresh '
+            'step-numbered directory (kfac_tpu.resilience.CheckpointManager '
+            'manages such a rotation with an atomic LATEST pointer)'
+        )
     payload = {'kfac': durable_state(state)}
     if extra:
         payload.update(extra)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, payload)
+    ckptr.save(path, payload, force=overwrite)
     # remove any STALE sidecar from an earlier save at this path
     # immediately (before the async return): whatever happens next — crash
     # pre-commit (no checkpoint, no manifest) or crash between orbax's
@@ -284,7 +307,14 @@ def save(
 class _AsyncSaveHandle:
     """Returned by ``save(..., wait=False)``: finishing the write also
     finalizes the manifest sidecar, preserving the invariant that a
-    manifest on disk implies a durable checkpoint."""
+    manifest on disk implies a durable checkpoint.
+
+    Usable as a context manager (``with save(..., wait=False):`` waits on
+    exit). Dropping the handle without ``wait_until_finished()`` warns: the
+    orbax background threads may still commit the checkpoint, but the
+    manifest is never finalized — a durable checkpoint that silently lost
+    its cross-layout migration metadata.
+    """
 
     def __init__(self, ckptr, finalize):
         self._ckptr = ckptr
@@ -296,6 +326,28 @@ class _AsyncSaveHandle:
         if not self._done:
             self._done = True
             self._finalize()
+
+    def __enter__(self) -> '_AsyncSaveHandle':
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.wait_until_finished()
+
+    def __del__(self) -> None:
+        if getattr(self, '_done', True):
+            return
+        try:  # pragma: no cover - interpreter-shutdown ordering
+            _warnings.warn(
+                'async checkpoint save handle dropped without '
+                'wait_until_finished(): the checkpoint may commit in the '
+                'background but its layout manifest is never written '
+                '(cross-layout migration will be unavailable); hold the '
+                'handle and wait on it, or use it as a context manager',
+                ResourceWarning,
+                stacklevel=2,
+            )
+        except Exception:
+            pass
 
 
 def restore(
@@ -331,6 +383,21 @@ def restore(
     if mpath is not None and os.path.exists(mpath):
         with open(mpath) as f:
             saved_man = json.load(f)
+    elif mpath is not None and os.path.isdir(path):
+        # the checkpoint committed but its sidecar never landed: either a
+        # crash between orbax's commit and the manifest finalize (the
+        # async-save window CheckpointManager's rotation tolerates) or a
+        # save() without engine= — restore proceeds layout-exact either way
+        from kfac_tpu.warnings import CheckpointResilienceWarning
+
+        _warnings.warn(
+            f'checkpoint at {path!r} has no layout-manifest sidecar '
+            '(saved without engine=, or the writer died between the orbax '
+            'commit and the manifest finalize): restoring manifest-less — '
+            'cross-layout migration is unavailable for this checkpoint',
+            CheckpointResilienceWarning,
+            stacklevel=2,
+        )
     if saved_man is not None:
         cur_man = layout_manifest(engine)
         if _layout_view(saved_man) != _layout_view(cur_man):
@@ -516,6 +583,20 @@ def _migrate_restore(
             jnp.asarray, raw['kfac']['health']
         )
         state = state._replace(health=_health_from_saved(saved_h))
+
+    # pin the migrated state to the new engine's declared shardings: the
+    # insert/rematerialize path mostly lands there already, but factors
+    # that round-tripped through host numpy (and the scalar step) may sit
+    # committed to default placement — an elastic restore onto a different
+    # mesh must hand back arrays jit can consume without a resharding
+    # surprise on the first donated step
+    shard_fn = getattr(engine, 'state_shardings', None)
+    if callable(shard_fn):
+        shardings = shard_fn()
+        if shardings is not None and jax.tree_util.tree_structure(
+            state
+        ) == jax.tree_util.tree_structure(shardings):
+            state = jax.device_put(state, shardings)
 
     if extra_template:
         # The target-less restore flattens custom pytree nodes (optax

@@ -443,6 +443,7 @@ class PostmortemWriter:
         collector: 'metrics_lib.MetricsCollector | None' = None,
         max_bundles: int = 16,
         all_processes: bool = False,
+        checkpoint_manager: Any = None,
     ) -> None:
         self.root = os.fspath(root)
         os.makedirs(self.root, exist_ok=True)
@@ -450,6 +451,10 @@ class PostmortemWriter:
         self.collector = collector or metrics_lib.MetricsCollector()
         self.max_bundles = int(max_bundles)
         self.all_processes = bool(all_processes)
+        # a resilience.CheckpointManager: a degrade event additionally
+        # flushes ONE emergency blocking checkpoint (the state that
+        # diverged, preserved for offline replay next to the bundle)
+        self.checkpoint_manager = checkpoint_manager
         self.bundles: list[str] = []
         self._seen_skipped = 0
         self._seen_events = 0
@@ -544,13 +549,21 @@ class PostmortemWriter:
                 self._last_nonfinite_step = step
         if not reasons:
             return None
+        emergency_ckpt = None
+        if 'degrade' in reasons and self.checkpoint_manager is not None:
+            # every process enters the blocking save (SPMD symmetry for
+            # sharded state), exactly once per degrade event because the
+            # trigger above already dedupes against _seen_degraded
+            emergency_ckpt = self.checkpoint_manager.save_emergency(
+                state, reason='degrade'
+            )
         if not self.all_processes and jax.process_index() != 0:
             return None
         if len(self.bundles) >= self.max_bundles:
             return None
         return self.write_bundle(
             kstate, '-'.join(reasons), record=record, history=history,
-            step=step,
+            step=step, emergency_checkpoint=emergency_ckpt,
         )
 
     # ---------------------------------------------------------- the bundle
@@ -562,6 +575,7 @@ class PostmortemWriter:
         record: dict[str, Any] | None = None,
         history: list[dict[str, Any]] | None = None,
         step: int | None = None,
+        emergency_checkpoint: str | None = None,
     ) -> str:
         """Dump one bundle directory unconditionally; returns its path.
 
@@ -636,6 +650,10 @@ class PostmortemWriter:
             'process_index': jax.process_index(),
             'record': record,
             'files': sorted(files),
+            # rotation path of the emergency checkpoint flushed for this
+            # event (degrade events with a CheckpointManager wired in),
+            # so offline replay can load the exact diverged state
+            'emergency_checkpoint': emergency_checkpoint,
         })
         self.bundles.append(bdir)
         return bdir

@@ -1384,6 +1384,21 @@ class DistributedKFAC:
             lines.append(f'  {layer}: {workers}')
         return '\n'.join(lines)
 
+    def topology(self) -> dict[str, Any]:
+        """Process/device/mesh topology snapshot, recorded
+        (informationally) into checkpoint layout manifests so an elastic
+        restore can report which topologies it moved a checkpoint
+        between."""
+        import numpy as _np
+
+        return {
+            'process_count': jax.process_count(),
+            'device_count': jax.device_count(),
+            'backend': jax.default_backend(),
+            'mesh_axes': list(self.mesh.axis_names),
+            'mesh_shape': [int(s) for s in _np.shape(self.mesh.devices)],
+        }
+
     def slot_device(self, side: str, name: str) -> Any:
         """The device that stores AND decomposes ``name``'s A or G factor.
 

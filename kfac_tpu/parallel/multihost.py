@@ -120,6 +120,44 @@ def allgather_scalars(values: np.ndarray | Sequence[float]) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(arr))
 
 
+def agree_emergency(code: int, step: int) -> tuple[int, int]:
+    """Cross-host barrier for emergency-checkpoint requests.
+
+    Each host contributes ``(code, step)`` — ``code`` 0 when it saw no
+    preemption signal, higher values for more urgent semantics (see
+    ``resilience.signals``) — and every host receives the pod-wide
+    ``(max code, max step)``. A SIGTERM delivered to a single host
+    therefore drives ALL hosts into the same emergency save at the same
+    agreed step. Built on :func:`allgather_scalars`, so single-process it
+    is a pure-numpy identity; every process must call it at the same step
+    cadence (SPMD symmetry).
+    """
+    if jax.process_count() == 1:
+        return int(code), int(step)
+    gathered = allgather_scalars([float(code), float(step)])
+    return int(gathered[:, 0].max()), int(gathered[:, 1].max())
+
+
+def assert_same_step(step: int, what: str = 'restored checkpoint') -> None:
+    """Verify every process agrees on ``step``; raise naming the spread.
+
+    Used after ``resilience.CheckpointManager.restore_latest``: hosts
+    walking divergent local rotations (torn NFS caches, one host missing
+    the newest dir) would otherwise silently resume from different steps
+    and corrupt the run at the first collective.
+    """
+    if jax.process_count() == 1:
+        return
+    gathered = allgather_scalars([float(step)])[:, 0]
+    if not (gathered == gathered[0]).all():
+        raise RuntimeError(
+            f'{what}: processes disagree on the step — per-process view '
+            f'{[int(s) for s in gathered]}; the checkpoint rotation is '
+            'inconsistent across hosts (shared filesystem lag or a torn '
+            'rotation); re-sync the checkpoint directory before resuming'
+        )
+
+
 def process_count() -> int:
     return jax.process_count()
 

@@ -945,6 +945,17 @@ class KFACPreconditioner:
             )
         return '\n'.join(lines)
 
+    def topology(self) -> dict[str, Any]:
+        """Process/device topology snapshot, recorded (informationally)
+        into checkpoint layout manifests so an elastic restore can report
+        what it moved between; the dense engine has no mesh, so this is
+        the world shape only."""
+        return {
+            'process_count': jax.process_count(),
+            'device_count': jax.device_count(),
+            'backend': jax.default_backend(),
+        }
+
     def memory_usage(self, state: KFACState) -> dict[str, int]:
         """Approximate bytes held per category (reference:
         kfac/base_preconditioner.py:389-409)."""

@@ -1,0 +1,23 @@
+"""Preemption-safe training: checkpoint autopilot + signal handling.
+
+``CheckpointManager`` owns a keep-N rotation of step-numbered checkpoint
+directories with an atomically-updated ``LATEST`` pointer, drives
+periodic async saves from the Trainer step paths, flushes an emergency
+blocking save when a preemption signal arrives, and restores the newest
+*good* checkpoint with last-good fallback and elastic cross-topology
+migration. See docs/ROBUSTNESS.md ("Preemption & resume").
+"""
+
+from kfac_tpu.resilience import signals
+from kfac_tpu.resilience.manager import (
+    CheckpointManager,
+    Preempted,
+    RestoreResult,
+)
+
+__all__ = [
+    'CheckpointManager',
+    'Preempted',
+    'RestoreResult',
+    'signals',
+]

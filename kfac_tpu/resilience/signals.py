@@ -1,0 +1,141 @@
+"""Preemption-signal handling: flag-setting handlers, no work in the
+handler itself.
+
+TPU preemption (and most cluster schedulers) deliver a SIGTERM with a
+short grace window before the hard kill; operators poke long runs with
+SIGUSR1 to snapshot state without stopping them. A signal handler that
+does real work (checkpoint I/O, collectives) from interrupt context is a
+deadlock machine, so the handlers here only record *which* signal
+arrived; :class:`kfac_tpu.resilience.CheckpointManager` polls the flag at
+step boundaries — a safe point where no jit computation or collective is
+in flight — and performs the emergency blocking save there (rank 0
+coordinates; the other hosts reach the same save through the
+``multihost.allgather_scalars`` barrier in ``CheckpointManager.on_step``,
+so a signal delivered to only one host still checkpoints the whole pod).
+
+The signal table in ``docs/ROBUSTNESS.md`` is linted against
+:data:`HANDLED_SIGNALS` by ``tools/lint_signals.py`` (run via
+``make resilience``), so documented semantics cannot drift from the
+handlers actually registered here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import signal as _signal
+from typing import Iterable
+
+
+@dataclasses.dataclass(frozen=True)
+class SignalSpec:
+    """Semantics of one handled signal.
+
+    ``exits``: after the emergency checkpoint is durable, does training
+    stop (:class:`~kfac_tpu.resilience.Preempted` is raised) or continue?
+    """
+
+    name: str
+    exits: bool
+    description: str
+
+
+#: the signals :func:`install` handles by default, with their semantics —
+#: the source of truth for the docs/ROBUSTNESS.md signal table
+HANDLED_SIGNALS: dict[str, SignalSpec] = {
+    'SIGTERM': SignalSpec(
+        'SIGTERM', exits=True,
+        description='preemption notice: flush an emergency blocking '
+                    'checkpoint, then exit via Preempted',
+    ),
+    'SIGUSR1': SignalSpec(
+        'SIGUSR1', exits=False,
+        description='operator snapshot: flush an emergency blocking '
+                    'checkpoint, training continues',
+    ),
+}
+
+#: name of the most urgent signal seen and not yet consumed (exit signals
+#: outrank continue signals; within a rank, latest delivery wins)
+_pending: str | None = None
+
+
+def _handler_for(name: str):
+    def _handler(signum, frame):  # noqa: ARG001 - signal handler signature
+        global _pending
+        if _pending is None or (
+            HANDLED_SIGNALS[name].exits
+            and not HANDLED_SIGNALS[_pending].exits
+        ):
+            _pending = name
+    _handler.__kfac_signal__ = name  # lets tests identify our handlers
+    return _handler
+
+
+class SignalHandle:
+    """Installed-handler record; ``uninstall()`` restores what was there
+    before (context-manager friendly)."""
+
+    def __init__(self, previous: list[tuple[int, object]]) -> None:
+        self._previous = previous
+
+    def uninstall(self) -> None:
+        while self._previous:
+            signum, prev = self._previous.pop()
+            _signal.signal(signum, prev)
+
+    def __enter__(self) -> 'SignalHandle':
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.uninstall()
+
+
+def install(
+    signals: Iterable[str] = ('SIGTERM', 'SIGUSR1'),
+) -> SignalHandle:
+    """Install flag-setting handlers for the named signals.
+
+    Only signals listed in :data:`HANDLED_SIGNALS` are accepted (their
+    semantics are documented and linted); returns a :class:`SignalHandle`
+    whose ``uninstall()`` restores the previous handlers. Must run on the
+    main thread (a CPython ``signal.signal`` constraint).
+    """
+    previous: list[tuple[int, object]] = []
+    handle = SignalHandle(previous)
+    try:
+        for name in signals:
+            if name not in HANDLED_SIGNALS:
+                raise ValueError(
+                    f'unknown preemption signal {name!r}; handled signals: '
+                    f'{sorted(HANDLED_SIGNALS)}'
+                )
+            signum = getattr(_signal, name)
+            previous.append((signum, _signal.getsignal(signum)))
+            _signal.signal(signum, _handler_for(name))
+    except Exception:
+        handle.uninstall()
+        raise
+    return handle
+
+
+def preemption_requested() -> str | None:
+    """The pending signal name, or None. Does not clear the flag."""
+    return _pending
+
+
+def consume() -> str | None:
+    """Return and clear the pending signal flag."""
+    global _pending
+    name, _pending = _pending, None
+    return name
+
+
+def exits(name: str) -> bool:
+    """Whether the named signal's semantics end training after the save."""
+    return HANDLED_SIGNALS[name].exits
+
+
+def reset() -> None:
+    """Clear the pending flag (tests)."""
+    global _pending
+    _pending = None

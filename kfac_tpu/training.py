@@ -53,6 +53,14 @@ class Trainer:
             first-order baseline).
         optimizer: any optax gradient transformation.
         registry: layer registry (required when kfac is set).
+        checkpoints: optional
+            :class:`kfac_tpu.resilience.CheckpointManager`. Every step
+            path (:meth:`step`, :meth:`scan_steps`,
+            :meth:`step_accumulate`, :meth:`step_accumulate_scan`) calls
+            its ``on_step`` after the update, so periodic async saves and
+            preemption-signal emergency flushes ride the training loop
+            with no extra plumbing; :meth:`restore_latest` resumes from
+            its rotation.
     """
 
     loss_fn: Callable[..., Any]
@@ -61,6 +69,7 @@ class Trainer:
     registry: Any = None
     factor_update_steps: int = 1
     donate_state: bool = False
+    checkpoints: Any = None
 
     def __post_init__(self) -> None:
         # Host-side mirror of kfac_state.step, used only for cadence
@@ -77,6 +86,11 @@ class Trainer:
             self.kfac is not None
             and 'loss' in inspect.signature(self.kfac.step).parameters
         )
+        if self.checkpoints is not None and self.kfac is None:
+            raise ValueError(
+                'Trainer(checkpoints=...) requires a kfac preconditioner: '
+                'the CheckpointManager persists the K-FAC durable state'
+            )
         if self.kfac is not None:
             if self.registry is None:
                 self.registry = self.kfac.config.registry if hasattr(
@@ -242,6 +256,56 @@ class Trainer:
         if hc is not None and hc.warn:
             self.check_health(state)
 
+    def _drive_checkpoints(self, state: TrainState) -> None:
+        """Tick the checkpoint autopilot after a completed step.
+
+        ``self._step_count`` (when synced) spares the manager a device
+        read; after :meth:`scan_steps` it is None and the manager reads
+        the device counter itself. A :class:`kfac_tpu.resilience
+        .Preempted` raised here propagates out of the step call — by
+        then the emergency checkpoint is already durable.
+        """
+        if self.checkpoints is not None:
+            self.checkpoints.on_step(state, step=self._step_count)
+
+    def restore_latest(
+        self, params: Any, model_state: Any = None
+    ) -> TrainState | None:
+        """Resume from the ``checkpoints`` manager's newest good
+        checkpoint.
+
+        ``params``/``model_state`` serve as restore templates (shapes,
+        dtypes, shardings — e.g. from ``model.init``) and are returned
+        unchanged when the rotation is empty (fresh start). On success
+        the returned TrainState carries the restored params, optimizer
+        state, model state, and rematerialized K-FAC state, and the
+        Trainer's cadence dispatch is re-aligned to the restored step.
+        """
+        if self.checkpoints is None:
+            raise ValueError(
+                'Trainer has no checkpoints manager: construct with '
+                'checkpoints=CheckpointManager(...)'
+            )
+        template: dict[str, Any] = {
+            'params': params,
+            'opt_state': self.optimizer.init(params),
+        }
+        if model_state is not None:
+            template['model_state'] = model_state
+        result = self.checkpoints.restore_latest(
+            engine=self.kfac, extra_template=template
+        )
+        if result is None:
+            return None
+        state = TrainState(
+            params=result.extra['params'],
+            opt_state=result.extra['opt_state'],
+            kfac_state=result.state,
+            model_state=result.extra.get('model_state', model_state),
+        )
+        self.resume(state)
+        return state
+
     @tracing.trace(name='trainer/step')
     def step(self, state: TrainState, batch) -> tuple[TrainState, jax.Array]:
         """One optimization step; picks the capture variant on cadence.
@@ -261,6 +325,7 @@ class Trainer:
                 out = self._jit_no_stats(state, batch)
         self._step_count += 1
         self._maybe_warn(out[0])
+        self._drive_checkpoints(out[0])
         return out
 
     # ------------------------------------------------------- compiled loops
@@ -375,6 +440,7 @@ class Trainer:
             self._jit_scan = jax.jit(run, donate_argnums=donate)
         state, losses = self._jit_scan(state, batches)
         self._step_count = None  # host mirror resyncs from the device step
+        self._drive_checkpoints(state)
         return state, losses
 
     # --------------------------------------------------------- accumulation
@@ -488,6 +554,7 @@ class Trainer:
         self._accum = None
         self._step_count += 1
         self._maybe_warn(new_state)
+        self._drive_checkpoints(new_state)
         return new_state, loss
 
     @tracing.trace(name='trainer/step_accumulate')
@@ -591,6 +658,7 @@ class Trainer:
         out = self._jit_accum_scan(state, microbatches, with_stats=capture_now)
         self._step_count += 1
         self._maybe_warn(out[0])
+        self._drive_checkpoints(out[0])
         return out
 
     def _apply_accumulated(

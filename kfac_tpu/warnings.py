@@ -18,6 +18,12 @@ class NumericalHealthWarning(Warning):
     """A layer was quarantined or degraded by the health sentinel."""
 
 
+class CheckpointResilienceWarning(Warning):
+    """Checkpoint durability/restore anomaly that was handled gracefully
+    (manifest-less restore, fallback to an older rotation entry, retried
+    transient I/O) but an operator should know about."""
+
+
 # (layer, cause) pairs already warned about — each fires ONCE per process,
 # not once per step: a persistently sick layer would otherwise spam the log
 # at training-step frequency while saying nothing new.

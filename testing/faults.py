@@ -6,12 +6,14 @@ healthy original stays available for bitwise "nothing moved" assertions.
 Faults mirror the real-world failure modes the sentinel defends against
 (kfac_tpu/health.py): a corrupt input batch (dead loss/grads), a corrupt
 micro-batch inside an accumulation, poisoned curvature statistics, a
-factor blow-up past the conditioning bound, and factors corrupted at rest
-(e.g. a bad checkpoint).
+factor blow-up past the conditioning bound, factors corrupted at rest
+(e.g. a bad checkpoint), and torn checkpoint writes on disk (host crash
+or preemption mid-write — the resilience rotation's fallback trigger).
 """
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 import jax
@@ -160,3 +162,71 @@ def poison_factors(
     if missing:
         raise KeyError(f'layers {sorted(missing)} not in engine factors')
     return engine.insert_factors(state, out)
+
+
+#: supported on-disk checkpoint corruption modes
+CHECKPOINT_CORRUPTIONS = ('truncate', 'delete', 'garbage', 'metadata')
+
+
+def corrupt_checkpoint(path: str, mode: str = 'truncate') -> str:
+    """Deterministically corrupt a committed orbax checkpoint directory.
+
+    Simulates a torn write / partial loss after commit (host crash during
+    an fsync-less copy, filesystem rollback, bit rot): the checkpoint
+    still LOOKS committed (its metadata markers remain for every mode but
+    ``'metadata'``), so only an actual restore attempt discovers the
+    damage — exactly the case :meth:`kfac_tpu.resilience
+    .CheckpointManager.restore_latest` must survive by falling back to
+    the previous rotation entry.
+
+    The victim is chosen deterministically (largest payload file, path as
+    the tie-break), no RNG. Modes:
+
+    - ``'truncate'``: cut the victim to half its size (torn write).
+    - ``'delete'``: remove the victim (lost object).
+    - ``'garbage'``: overwrite the victim's first bytes in place
+      (bit rot / torn page).
+    - ``'metadata'``: remove the orbax commit markers — the checkpoint no
+      longer looks committed at all (crash before commit).
+
+    Returns the corrupted/removed file's path.
+    """
+    if mode not in CHECKPOINT_CORRUPTIONS:
+        raise ValueError(
+            f'unknown corruption mode {mode!r}; expected one of '
+            f'{CHECKPOINT_CORRUPTIONS}'
+        )
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f'checkpoint dir {path!r} does not exist')
+    if mode == 'metadata':
+        victim = None
+        for marker in ('_CHECKPOINT_METADATA', '_METADATA'):
+            mpath = os.path.join(path, marker)
+            if os.path.exists(mpath):
+                os.remove(mpath)
+                victim = mpath
+        if victim is None:
+            raise FileNotFoundError(
+                f'no orbax metadata markers under {path!r}'
+            )
+        return victim
+    candidates = []
+    for root, _, files in os.walk(path):
+        for name in files:
+            if name.startswith('_'):  # keep commit markers intact
+                continue
+            fp = os.path.join(root, name)
+            candidates.append((-os.path.getsize(fp), fp))
+    if not candidates:
+        raise FileNotFoundError(f'no payload files under {path!r}')
+    _, victim = min(candidates)
+    if mode == 'delete':
+        os.remove(victim)
+    elif mode == 'truncate':
+        size = os.path.getsize(victim)
+        with open(victim, 'r+b') as f:
+            f.truncate(size // 2)
+    else:  # garbage
+        with open(victim, 'r+b') as f:
+            f.write(b'\xde\xad\xbe\xef' * 16)
+    return victim

@@ -1,0 +1,101 @@
+"""Worker process for the subprocess preemption test.
+
+Launched by tests/test_resilience.py as a real OS process so the parent
+can deliver a genuine ``kill -TERM`` mid-training — the in-process signal
+tests cover the flag/poll machinery, this covers the whole contract: the
+handler fires in interrupt context, the next step boundary flushes an
+emergency blocking save, ``Preempted`` unwinds the loop, and the process
+exits 0 leaving a durable rotation a SECOND invocation resumes from
+(``Trainer.restore_latest``) with step/loss continuity.
+
+Usage: python resilience_worker.py <ckpt_dir> <max_steps> <save_interval>
+[<per_step_sleep_s>]. Emits one JSON line per event (start / step /
+preempted / done) on stdout; the parent reads the stream to time its
+signal and to assert continuity.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
+
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+import kfac_tpu  # noqa: E402
+from kfac_tpu.resilience import CheckpointManager, Preempted  # noqa: E402
+from testing import models  # noqa: E402
+
+
+def emit(**payload) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def main() -> None:
+    ckpt_dir = sys.argv[1]
+    max_steps = int(sys.argv[2])
+    save_interval = int(sys.argv[3])
+    step_sleep = float(sys.argv[4]) if len(sys.argv) > 4 else 0.0
+
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg, kl_clip=None)
+
+    def loss_fn(params, model_state, batch):
+        bx, by = batch
+        pred = m.apply({'params': params}, bx)
+        return jnp.mean((pred - by) ** 2), model_state
+
+    manager = CheckpointManager(
+        ckpt_dir, engine=kfac, save_interval_steps=save_interval, keep=2
+    )
+    trainer = kfac_tpu.Trainer(
+        loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=kfac,
+        checkpoints=manager,
+    )
+    state = trainer.restore_latest(params)
+    if state is None:
+        state = trainer.init(params)
+    start = int(jax.device_get(state.kfac_state.step))
+    emit(event='start', resumed_step=start)
+    loss = None
+    try:
+        for _ in range(start, max_steps):
+            state, loss = trainer.step(state, (x, y))
+            emit(
+                event='step',
+                step=int(jax.device_get(state.kfac_state.step)),
+                loss=float(loss),
+            )
+            if step_sleep:
+                time.sleep(step_sleep)
+        manager.finalize()
+        emit(
+            event='done',
+            final_step=int(jax.device_get(state.kfac_state.step)),
+            loss=float(loss) if loss is not None else None,
+            latest=manager.latest_step(),
+        )
+    except Preempted as exc:
+        emit(
+            event='preempted',
+            signal=exc.signal_name,
+            saved_step=exc.step,
+            path=exc.path,
+            latest=manager.latest_step(),
+        )
+        sys.exit(0)
+
+
+if __name__ == '__main__':
+    main()

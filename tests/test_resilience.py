@@ -1,0 +1,586 @@
+"""Tests for the preemption-safe checkpoint autopilot (kfac_tpu.resilience).
+
+Covers the rotation invariants (fresh step dirs, atomic LATEST pointer,
+keep-N pruning), the signal machinery (flag-only handlers, exit-outranks-
+continue priority, on_step emergency flush), torn-write fallback via
+testing/faults.corrupt_checkpoint, transient-I/O retry/backoff, elastic
+dense <-> stacked restore through the manager, Trainer-integrated periodic
+saves + resume continuity, and — slow-marked — a real ``kill -TERM``
+against a subprocess training run that must leave a durable, resumable
+checkpoint behind.
+"""
+
+import gc
+import importlib.util
+import json
+import os
+import signal as signal_mod
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import kfac_tpu
+from kfac_tpu import checkpoint
+from kfac_tpu.resilience import CheckpointManager, Preempted, signals
+from kfac_tpu.warnings import CheckpointResilienceWarning
+from testing import models
+from testing.faults import corrupt_checkpoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, 'testing', 'resilience_worker.py')
+
+
+@pytest.fixture(autouse=True)
+def _clean_signal_state():
+    signals.reset()
+    yield
+    signals.reset()
+
+
+def _dense_setup(n=64):
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=n)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg, kl_clip=None)
+    return m, (x, y), params, reg, kfac
+
+
+def _run_steps(kfac, reg, m, params, batch, state=None, steps=1):
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(
+        models.mse_loss(m)
+    )
+    state = kfac.init() if state is None else state
+    grads = None
+    for _ in range(steps):
+        (_, _), grads, stats = run(params, batch)
+        state, pg = kfac.step(state, grads, stats)
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - 0.05 * g, params, pg
+        )
+    return state, params, grads
+
+
+# ------------------------------------------------------------------ rotation
+
+
+def test_rotation_keep_and_atomic_latest_pointer(tmp_path):
+    m, batch, params, reg, kfac = _dense_setup()
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(
+        models.mse_loss(m)
+    )
+    mgr = CheckpointManager(
+        tmp_path, engine=kfac, save_interval_steps=2, keep=2,
+        install_signals=(),
+    )
+    state = kfac.init()
+    for _ in range(6):
+        (_, _), grads, stats = run(params, batch)
+        state, pg = kfac.step(state, grads, stats)
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - 0.05 * g, params, pg
+        )
+        mgr.on_step(state)
+    mgr.finalize()
+    # saved on cadence at steps 2, 4, 6; keep=2 pruned step 2
+    assert mgr.rotation_steps() == [6, 4]
+    assert mgr.latest_step() == 6
+    with open(tmp_path / 'LATEST') as f:
+        assert f.read().strip() == 'step_00000006'
+    assert not os.path.exists(mgr.step_dir(2))
+    for s in (4, 6):
+        assert mgr._is_committed(s)
+        # manifest sidecar rode along (elastic restore stays available)
+        assert os.path.exists(mgr.checkpoint_path(s) + '.manifest.json')
+
+
+def test_restore_latest_roundtrip(tmp_path):
+    m, batch, params, reg, kfac = _dense_setup()
+    state, params, grads = _run_steps(kfac, reg, m, params, batch, steps=2)
+    mgr = CheckpointManager(
+        tmp_path, engine=kfac, install_signals=(), async_save=False
+    )
+    path = mgr.save(state)
+    result = mgr.restore_latest()
+    assert result.step == 2
+    assert result.path == path
+    assert result.extra == {}
+    np.testing.assert_allclose(
+        np.asarray(result.state.a['fc1']), np.asarray(state.a['fc1']),
+        rtol=1e-6,
+    )
+    p1 = kfac.precondition(state, grads)
+    p2 = kfac.precondition(result.state, grads)
+    np.testing.assert_allclose(
+        np.asarray(p1['fc1']['kernel']), np.asarray(p2['fc1']['kernel']),
+        rtol=1e-5, atol=1e-7,
+    )
+
+
+def test_restore_latest_empty_rotation(tmp_path):
+    _, _, _, _, kfac = _dense_setup()
+    mgr = CheckpointManager(tmp_path, engine=kfac, install_signals=())
+    assert mgr.restore_latest() is None
+    mgr2 = CheckpointManager(tmp_path / 'other', install_signals=())
+    with pytest.raises(ValueError, match='engine'):
+        mgr2.restore_latest()
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize('mode', ['truncate', 'delete', 'metadata'])
+def test_restore_falls_back_past_torn_checkpoint(tmp_path, mode):
+    """A corrupt newest checkpoint (torn write, lost object, or missing
+    commit markers) is skipped with a warning; the previous rotation
+    entry restores — the run resumes instead of crashing."""
+    m, batch, params, reg, kfac = _dense_setup()
+    mgr = CheckpointManager(
+        tmp_path, engine=kfac, install_signals=(), async_save=False, keep=3
+    )
+    state, params, _ = _run_steps(kfac, reg, m, params, batch)
+    mgr.save(state)
+    state, params, _ = _run_steps(
+        kfac, reg, m, params, batch, state=state
+    )
+    newest = mgr.save(state)
+    assert mgr.latest_step() == 2
+    corrupt_checkpoint(newest, mode=mode)
+    with pytest.warns(CheckpointResilienceWarning, match='falling back'):
+        result = mgr.restore_latest()
+    assert result.step == 1
+    assert result.path == mgr.checkpoint_path(1)
+    # the fallback warning is rate-limited per path: a second walk stays
+    # quiet about the same corpse
+    import warnings as warnings_mod
+
+    with warnings_mod.catch_warnings():
+        warnings_mod.simplefilter('error', CheckpointResilienceWarning)
+        assert mgr.restore_latest().step == 1
+
+
+def test_corrupt_checkpoint_rejects_unknown_mode(tmp_path):
+    with pytest.raises(ValueError, match='unknown corruption mode'):
+        corrupt_checkpoint(str(tmp_path), mode='bitflip')
+    with pytest.raises(FileNotFoundError):
+        corrupt_checkpoint(str(tmp_path / 'nope'), mode='truncate')
+
+
+# ----------------------------------------------------- checkpoint.py policy
+
+
+def test_save_overwrite_policy(tmp_path):
+    m, batch, params, reg, kfac = _dense_setup()
+    state, params, _ = _run_steps(kfac, reg, m, params, batch)
+    path = str(tmp_path / 'ckpt')
+    checkpoint.save(path, state, engine=kfac)
+    # the default refuses and the error names the path + the escape hatch
+    with pytest.raises(ValueError, match='overwrite=True'):
+        checkpoint.save(path, state)
+    with pytest.raises(ValueError, match='ckpt'):
+        checkpoint.save(path, state)
+    state2, _, _ = _run_steps(kfac, reg, m, params, batch, state=state)
+    checkpoint.save(path, state2, engine=kfac, overwrite=True)
+    restored, _ = checkpoint.restore(path, kfac)
+    assert int(restored.step) == 2
+
+
+def test_async_handle_context_manager(tmp_path):
+    m, batch, params, reg, kfac = _dense_setup()
+    state, _, _ = _run_steps(kfac, reg, m, params, batch)
+    path = str(tmp_path / 'actx')
+    with checkpoint.save(path, state, engine=kfac, wait=False) as handle:
+        pass
+    # __exit__ waited: checkpoint durable and manifest finalized
+    assert os.path.exists(path + '.manifest.json')
+    restored, _ = checkpoint.restore(path, kfac)
+    assert int(restored.step) == 1
+    handle.wait_until_finished()  # idempotent
+
+
+def test_async_handle_dropped_without_wait_warns(tmp_path):
+    m, batch, params, reg, kfac = _dense_setup()
+    state, _, _ = _run_steps(kfac, reg, m, params, batch)
+    handle = checkpoint.save(str(tmp_path / 'adrop'), state, wait=False)
+    ckptr = handle._ckptr  # keep orbax alive to drain its threads after
+    with pytest.warns(ResourceWarning, match='wait_until_finished'):
+        del handle
+        gc.collect()
+    ckptr.wait_until_finished()
+
+
+def test_restore_without_manifest_warns(tmp_path):
+    m, batch, params, reg, kfac = _dense_setup()
+    state, _, _ = _run_steps(kfac, reg, m, params, batch)
+    path = str(tmp_path / 'bare')
+    checkpoint.save(path, state)  # no engine= -> no manifest sidecar
+    with pytest.warns(CheckpointResilienceWarning, match='manifest'):
+        restored, _ = checkpoint.restore(path, kfac)
+    assert int(restored.step) == 1
+
+
+# ------------------------------------------------------------------- signals
+
+
+def test_signal_flag_priority_and_uninstall():
+    before_term = signal_mod.getsignal(signal_mod.SIGTERM)
+    before_usr1 = signal_mod.getsignal(signal_mod.SIGUSR1)
+    with signals.install():
+        assert signals.preemption_requested() is None
+        os.kill(os.getpid(), signal_mod.SIGUSR1)
+        assert signals.preemption_requested() == 'SIGUSR1'
+        os.kill(os.getpid(), signal_mod.SIGTERM)
+        assert signals.preemption_requested() == 'SIGTERM'
+        # a continue-signal cannot demote a pending exit-signal
+        os.kill(os.getpid(), signal_mod.SIGUSR1)
+        assert signals.preemption_requested() == 'SIGTERM'
+        assert signals.consume() == 'SIGTERM'
+        assert signals.preemption_requested() is None
+    assert signal_mod.getsignal(signal_mod.SIGTERM) is before_term
+    assert signal_mod.getsignal(signal_mod.SIGUSR1) is before_usr1
+    with pytest.raises(ValueError, match='SIGHUP'):
+        signals.install(['SIGHUP'])
+
+
+def test_on_step_sigusr1_saves_and_continues(tmp_path):
+    m, batch, params, reg, kfac = _dense_setup()
+    state, _, _ = _run_steps(kfac, reg, m, params, batch)
+    with CheckpointManager(
+        tmp_path, engine=kfac, save_interval_steps=None
+    ) as mgr:
+        assert mgr.on_step(state) is None  # no signal, periodic disabled
+        os.kill(os.getpid(), signal_mod.SIGUSR1)
+        path = mgr.on_step(state)
+        assert path == mgr.checkpoint_path(1)
+        assert mgr.latest_step() == 1
+        assert signals.preemption_requested() is None  # consumed
+        assert mgr.on_step(state) is None  # training continues normally
+
+
+def test_on_step_sigterm_preempts_after_durable_save(tmp_path):
+    m, batch, params, reg, kfac = _dense_setup()
+    state, _, _ = _run_steps(kfac, reg, m, params, batch)
+    with CheckpointManager(
+        tmp_path, engine=kfac, save_interval_steps=None
+    ) as mgr:
+        os.kill(os.getpid(), signal_mod.SIGTERM)
+        with pytest.raises(Preempted, match='SIGTERM') as excinfo:
+            mgr.on_step(state)
+        assert excinfo.value.step == 1
+        # by the time Preempted unwinds, the checkpoint is durable
+        assert mgr.latest_step() == 1
+        assert mgr.restore_latest().step == 1
+
+
+def test_save_emergency_reuses_committed_step(tmp_path):
+    m, batch, params, reg, kfac = _dense_setup()
+    state, _, _ = _run_steps(kfac, reg, m, params, batch)
+    mgr = CheckpointManager(
+        tmp_path, engine=kfac, install_signals=(), async_save=False
+    )
+    path = mgr.save(state)
+    sentinel = os.path.join(mgr.step_dir(1), 'sentinel')
+    open(sentinel, 'w').close()
+    # already durable: the grace window is not spent re-writing the bytes
+    assert mgr.save_emergency(state, reason='test') == path
+    assert os.path.exists(sentinel)
+
+
+# ------------------------------------------------------------ retry/backoff
+
+
+def test_retry_backoff_on_transient_io(tmp_path, monkeypatch):
+    m, batch, params, reg, kfac = _dense_setup()
+    state, _, _ = _run_steps(kfac, reg, m, params, batch)
+    sleeps = []
+    mgr = CheckpointManager(
+        tmp_path, engine=kfac, install_signals=(), async_save=False,
+        backoff_base=0.5, backoff_max=8.0, sleep=sleeps.append,
+    )
+    real_save = checkpoint.save
+    calls = {'n': 0}
+
+    def flaky(*args, **kwargs):
+        calls['n'] += 1
+        if calls['n'] <= 2:
+            raise OSError('simulated transient I/O failure')
+        return real_save(*args, **kwargs)
+
+    monkeypatch.setattr(checkpoint, 'save', flaky)
+    with pytest.warns(CheckpointResilienceWarning, match='retry'):
+        mgr.save(state)
+    assert calls['n'] == 3
+    assert sleeps == [0.5, 1.0]  # capped exponential backoff
+    monkeypatch.undo()
+    assert mgr.restore_latest().step == 1
+
+
+def test_retry_exhaustion_raises(tmp_path, monkeypatch):
+    m, batch, params, reg, kfac = _dense_setup()
+    state, _, _ = _run_steps(kfac, reg, m, params, batch)
+    sleeps = []
+    mgr = CheckpointManager(
+        tmp_path, engine=kfac, install_signals=(), async_save=False,
+        max_retries=1, backoff_base=0.5, sleep=sleeps.append,
+    )
+
+    def always_fail(*args, **kwargs):
+        raise OSError('disk on fire')
+
+    monkeypatch.setattr(checkpoint, 'save', always_fail)
+    with pytest.warns(CheckpointResilienceWarning, match='retry'):
+        with pytest.raises(OSError, match='disk on fire'):
+            mgr.save(state)
+    assert sleeps == [0.5]
+
+
+# ------------------------------------------------------------------- elastic
+
+
+def test_elastic_restore_dense_and_stacked_via_manager(tmp_path):
+    """Acceptance: a dense checkpoint restores through the manager into a
+    stacked engine with a different bucket_granularity (and back),
+    factors allclose, on the 8-device CPU mesh."""
+    from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+
+    m, batch, params, reg, kfac = _dense_setup()
+    state, params, grads = _run_steps(kfac, reg, m, params, batch, steps=2)
+    mgr = CheckpointManager(
+        tmp_path / 'fwd', engine=kfac, install_signals=(), async_save=False
+    )
+    mgr.save(state)
+
+    mesh = kaisa_mesh(grad_worker_fraction=0.5)
+    dk = DistributedKFAC(
+        config=kfac_tpu.KFACPreconditioner(
+            registry=reg, kl_clip=None, bucket_granularity=128
+        ),
+        mesh=mesh,
+    )
+    with pytest.warns(UserWarning, match='migrating'):
+        result = mgr.restore_latest(engine=dk)
+    assert result.step == 2
+    src = kfac.extract_factors(state)
+    dst = dk.extract_factors(result.state)
+    for name, fg in src.items():
+        for side in ('a', 'g'):
+            np.testing.assert_allclose(
+                np.asarray(dst[name][side]), np.asarray(fg[side]),
+                rtol=1e-6, err_msg=f'{name}/{side}',
+            )
+    p1 = kfac.precondition(state, grads)
+    p2 = dk.precondition(result.state, grads)
+    np.testing.assert_allclose(
+        np.asarray(p1['fc1']['kernel']), np.asarray(p2['fc1']['kernel']),
+        rtol=1e-4, atol=1e-6,
+    )
+
+    # and back: stacked -> fresh dense engine
+    mgr2 = CheckpointManager(
+        tmp_path / 'back', engine=dk, install_signals=(), async_save=False
+    )
+    mgr2.save(result.state)
+    kfac2 = kfac_tpu.KFACPreconditioner(registry=reg, kl_clip=None)
+    with pytest.warns(UserWarning, match='migrating'):
+        back = mgr2.restore_latest(engine=kfac2)
+    assert back.step == 2
+    for name, fg in src.items():
+        for side in ('a', 'g'):
+            np.testing.assert_allclose(
+                np.asarray(kfac2.extract_factors(back.state)[name][side]),
+                np.asarray(fg[side]), rtol=1e-6,
+                err_msg=f'{name}/{side}',
+            )
+
+
+# -------------------------------------------------------- Trainer lifecycle
+
+
+def test_trainer_periodic_saves_and_resume_continuity(tmp_path):
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+
+    def loss_fn(p, model_state, batch):
+        bx, by = batch
+        pred = m.apply({'params': p}, bx)
+        return jnp.mean((pred - by) ** 2), model_state
+
+    def make(directory):
+        kfac = kfac_tpu.KFACPreconditioner(registry=reg, kl_clip=None)
+        mgr = CheckpointManager(
+            directory, engine=kfac, save_interval_steps=2, keep=2,
+            install_signals=(),
+        )
+        trainer = kfac_tpu.Trainer(
+            loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=kfac,
+            checkpoints=mgr,
+        )
+        return trainer, mgr
+
+    trainer, mgr = make(tmp_path)
+    state = trainer.init(params)
+    losses, state_at_4 = [], None
+    for i in range(5):
+        state, loss = trainer.step(state, (x, y))
+        losses.append(float(loss))
+        if i == 3:
+            state_at_4 = state
+    mgr.finalize()
+    assert mgr.latest_step() == 4
+    assert mgr.rotation_steps() == [4, 2]
+
+    trainer2, mgr2 = make(tmp_path)
+    resumed = trainer2.restore_latest(params)
+    assert resumed is not None
+    assert int(jax.device_get(resumed.kfac_state.step)) == 4
+    np.testing.assert_array_equal(
+        np.asarray(resumed.params['fc1']['kernel']),
+        np.asarray(state_at_4.params['fc1']['kernel']),
+    )
+    # continuity: the resumed run's next step reproduces the original
+    # run's 5th step
+    resumed, loss5 = trainer2.step(resumed, (x, y))
+    np.testing.assert_allclose(float(loss5), losses[4], rtol=1e-6)
+    assert trainer2._step_count == 5
+    assert int(jax.device_get(resumed.kfac_state.step)) == 5
+
+    # an empty rotation hands the caller back to a fresh start
+    trainer3, _ = make(tmp_path / 'empty')
+    assert trainer3.restore_latest(params) is None
+
+
+@pytest.mark.faults
+def test_postmortem_degrade_flushes_emergency_checkpoint(tmp_path):
+    """The health sentinel's degrade event, observed by the flight
+    recorder's PostmortemWriter, flushes one emergency checkpoint into
+    the manager's rotation and records its path in the bundle MANIFEST —
+    the diverged state is preserved next to the telemetry."""
+    from kfac_tpu import health as health_lib
+    from testing import faults
+
+    m, batch, params, reg, _ = _dense_setup()
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg, kl_clip=None, flight=8,
+        health=health_lib.HealthConfig(warn=False, degrade_after=1),
+    )
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(
+        models.mse_loss(m)
+    )
+    step = jax.jit(kfac.step)
+    mgr = CheckpointManager(
+        tmp_path / 'rot', engine=kfac, install_signals=(),
+        async_save=False, save_interval_steps=None,
+    )
+    pm = kfac_tpu.PostmortemWriter(
+        tmp_path / 'pms', engine=kfac, checkpoint_manager=mgr
+    )
+    state = kfac.init()
+    (_, _), grads, stats = run(params, batch)
+    state, _ = step(state, grads, stats, loss=jnp.float32(1.0))
+    assert pm.observe(state) is None
+    assert mgr.latest_step() is None  # healthy steps save nothing
+    state, _ = step(
+        state, grads, faults.poison_stats(stats, 'fc2', side='a'),
+        loss=jnp.float32(1.0),
+    )
+    bundle = pm.observe(state)
+    assert bundle is not None and 'degrade' in os.path.basename(bundle)
+    man = json.load(open(os.path.join(bundle, 'MANIFEST.json')))
+    assert man['emergency_checkpoint'] == mgr.checkpoint_path(2)
+    assert mgr.latest_step() == 2
+    # the quarantine rolled the poisoned factor back, so the emergency
+    # checkpoint holds healthy factors and restores cleanly
+    assert mgr.restore_latest().step == 2
+
+
+# --------------------------------------------------------------- subprocess
+
+
+def _read_events(text):
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith('{'):
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+    return events
+
+
+@pytest.mark.slow
+def test_subprocess_sigterm_leaves_resumable_checkpoint(tmp_path):
+    """Real preemption: kill -TERM a live training process mid-run. The
+    worker must exit 0 with a durable emergency checkpoint, and a second
+    invocation must resume from exactly that step and train on."""
+    ckpt_dir = str(tmp_path / 'rot')
+    env = dict(os.environ)
+    env['PALLAS_AXON_POOL_IPS'] = ''  # never touch the TPU tunnel
+    env['JAX_PLATFORMS'] = 'cpu'
+    env.pop('XLA_FLAGS', None)  # single-device worker: fastest compile
+    env.setdefault(
+        'JAX_COMPILATION_CACHE_DIR', os.path.join(REPO, '.jax_cache')
+    )
+    err_path = tmp_path / 'worker.err'
+    with open(err_path, 'w') as errf:
+        proc = subprocess.Popen(
+            [sys.executable, WORKER, ckpt_dir, '1000', '2', '0.1'],
+            stdout=subprocess.PIPE, stderr=errf, text=True, env=env,
+            cwd=REPO,
+        )
+        events = []
+        try:
+            # the worker self-terminates only via Preempted, so the parent
+            # must send the signal once training is demonstrably underway
+            for line in proc.stdout:
+                events.extend(_read_events(line))
+                if events and events[-1].get('event') == 'step' and (
+                    events[-1]['step'] >= 3
+                ):
+                    proc.send_signal(signal_mod.SIGTERM)
+                    break
+            out, _ = proc.communicate(timeout=300)
+        finally:
+            proc.kill()
+    events.extend(_read_events(out))
+    assert proc.returncode == 0, err_path.read_text()[-4000:]
+    pre = [e for e in events if e.get('event') == 'preempted']
+    assert pre, events
+    assert pre[0]['signal'] == 'SIGTERM'
+    saved = pre[0]['saved_step']
+    assert saved >= 3
+    assert pre[0]['latest'] == saved
+    assert os.path.exists(os.path.join(ckpt_dir, 'LATEST'))
+
+    # phase 2: a fresh process resumes from the emergency checkpoint and
+    # runs two more steps to completion
+    done_run = subprocess.run(
+        [sys.executable, WORKER, ckpt_dir, str(saved + 2), '2'],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300,
+    )
+    assert done_run.returncode == 0, done_run.stderr[-4000:]
+    ev2 = _read_events(done_run.stdout)
+    start = next(e for e in ev2 if e['event'] == 'start')
+    done = next(e for e in ev2 if e['event'] == 'done')
+    assert start['resumed_step'] == saved
+    assert done['final_step'] == saved + 2
+    # one of the two extra steps hit the interval-2 cadence and its
+    # finalized periodic save moved the pointer past the emergency one
+    assert done['latest'] > saved
+
+
+# ---------------------------------------------------------------- docs lint
+
+
+def test_signal_doc_lint_in_sync():
+    spec = importlib.util.spec_from_file_location(
+        'lint_signals', os.path.join(REPO, 'tools', 'lint_signals.py')
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check(os.path.join(REPO, 'docs', 'ROBUSTNESS.md')) == []
