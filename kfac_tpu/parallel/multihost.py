@@ -120,6 +120,25 @@ def allgather_scalars(values: np.ndarray | Sequence[float]) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(arr))
 
 
+def barrier(name: str) -> None:
+    """Block until every process reaches this point (single-process:
+    no-op).
+
+    Used by ``resilience.CheckpointManager.save`` to order rank 0's
+    removal of a stale step directory before any host starts writing
+    into it. Every process must call this with the same ``name`` at the
+    same point in its call sequence (SPMD symmetry);
+    ``sync_global_devices`` raises if the names ever mismatch, turning a
+    skewed call pattern into a loud error instead of a silent pair-up of
+    unrelated collectives.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
 def agree_emergency(code: int, step: int) -> tuple[int, int]:
     """Cross-host barrier for emergency-checkpoint requests.
 

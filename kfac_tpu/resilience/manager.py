@@ -52,7 +52,8 @@ class Preempted(RuntimeError):
     """Raised by :meth:`CheckpointManager.on_step` after a successful
     emergency save for an exit-semantics signal (SIGTERM): the state is
     durable, unwind the training loop now — the platform's hard kill is
-    coming."""
+    coming. ``step`` (and the rotation entry) is the pod-agreed step
+    from the coordination barrier, identical on every host."""
 
     def __init__(self, signal_name: str, step: int, path: str) -> None:
         super().__init__(
@@ -121,8 +122,12 @@ class CheckpointManager:
         coordinate_every: multi-host only — every this-many steps,
             :meth:`on_step` runs the ``multihost.allgather_scalars``
             barrier that propagates one host's preemption signal to the
-            whole pod. 1 (default) reacts within a step; raise it if the
-            per-step DCN gather matters. Must be identical on all hosts.
+            whole pod. This is the pod's reaction latency: a signal seen
+            between coordinated steps stays pending until the next one
+            (every host enters the barrier on exactly the same steps, so
+            the collective always pairs up). 1 (default) reacts within a
+            step; raise it if the per-step DCN gather matters. Must be
+            identical on all hosts.
         max_retries / backoff_base / backoff_max: transient-I/O retry
             policy — each failed save attempt retries after
             ``min(backoff_max, backoff_base * 2**attempt)`` seconds.
@@ -243,13 +248,23 @@ class CheckpointManager:
 
     def _prune(self, protect: int) -> None:
         """Drop rotation entries beyond ``keep``, never the protected
-        (LATEST) step, and never an uncommitted newer dir that a
-        concurrent async save may still be writing."""
-        committed = [s for s in self.rotation_steps() if self._is_committed(s)]
+        (LATEST) step, and never an uncommitted dir newer than the
+        newest committed step (an async save may still be writing it).
+        Uncommitted dirs *older* than the newest committed step can no
+        longer be in-flight (saves are sequential and commit before the
+        next one starts) — they are torn corpses from crashed attempts,
+        pruned so the rotation walk stays bounded."""
+        steps = self.rotation_steps()
+        committed = [s for s in steps if self._is_committed(s)]
         for step in committed[self.keep:]:
             if step == protect:
                 continue
             shutil.rmtree(self.step_dir(step), ignore_errors=True)
+        if committed:
+            newest, live = committed[0], set(committed)
+            for step in steps:
+                if step < newest and step not in live and step != protect:
+                    shutil.rmtree(self.step_dir(step), ignore_errors=True)
 
     # --------------------------------------------------------------- saving
 
@@ -303,11 +318,23 @@ class CheckpointManager:
             step = _host_step(kstate)
         block = (not self.async_save) if block is None else block
         sdir = self.step_dir(step)
-        if os.path.exists(sdir):
+        from kfac_tpu.parallel import multihost
+
+        if multihost.process_index() == 0 and os.path.exists(sdir):
             # a dead earlier attempt at this step (crashed mid-write, or a
             # re-save after restore): the rotation never reuses bytes, so
-            # clear it and write fresh
-            shutil.rmtree(sdir)
+            # clear it and write fresh. Rank 0 only — on a shared
+            # filesystem concurrent rmtrees race each other (entries
+            # vanishing underneath a peer's walk raise OSError)
+            self._with_retries(
+                f'clearing stale rotation entry for step {step}',
+                lambda: shutil.rmtree(sdir),
+            )
+        if multihost.process_count() > 1:
+            # unconditional (the per-host exists-check may disagree under
+            # filesystem lag): no host starts writing until rank 0's
+            # clear above has finished
+            multihost.barrier(f'kfac-resilience-save-{step}')
         path = self.checkpoint_path(step)
 
         def attempt():
@@ -326,8 +353,15 @@ class CheckpointManager:
             self._pending = _PendingSave(handle, step)
         return path
 
-    def save_emergency(self, state: Any, reason: str = 'signal') -> str:
+    def save_emergency(
+        self, state: Any, reason: str = 'signal', step: int | None = None,
+    ) -> str:
         """Blocking save + commit for preemption / health events.
+
+        ``step`` defaults to the state's own counter; multi-host callers
+        must pass the same value on every host (``on_step`` passes the
+        pod-agreed step from the coordination barrier, so skewed hosts
+        still land in one rotation entry).
 
         Idempotent per step: if this step is already durable in the
         rotation (e.g. the periodic async save just committed it), the
@@ -336,8 +370,14 @@ class CheckpointManager:
         bytes that are already safe.
         """
         self._flush_pending()
-        kstate, _ = _split_train_state(state)
-        step = _host_step(kstate)
+        if step is None:
+            kstate, _ = _split_train_state(state)
+            step = _host_step(kstate)
+        _warnings.warn(
+            f'emergency checkpoint requested at step {step} ({reason})',
+            CheckpointResilienceWarning,
+            stacklevel=2,
+        )
         if self._is_committed(step):
             if self._last_saved_step != step:
                 self._commit(step)
@@ -346,25 +386,30 @@ class CheckpointManager:
 
     # -------------------------------------------------------------- driving
 
-    def _poll_emergency(self, step: int) -> int:
-        """Local signal flag -> pod-wide agreed emergency code."""
+    def _poll_emergency(self, step: int) -> tuple[int, int]:
+        """Local signal flag -> pod-wide agreed ``(code, step)``.
+
+        Multi-host, barrier participation depends ONLY on data every
+        host computes identically (the step cadence): a signal seen on
+        an off-cadence step stays pending until the next coordinated
+        step, so the allgather always pairs up host-for-host.
+        ``coordinate_every`` is therefore the pod's reaction latency to
+        a preemption signal, never a correctness knob.
+        """
         local = signals_lib.preemption_requested()
         code = _CODE_NONE
         if local is not None:
             code = _CODE_EXIT if signals_lib.exits(local) else _CODE_CONTINUE
         from kfac_tpu.parallel import multihost
 
-        if multihost.process_count() > 1 and (
-            step % self.coordinate_every == 0 or code != _CODE_NONE
-        ):
-            # NOTE: with coordinate_every > 1 a host that saw a signal
-            # still enters the barrier off-cadence; SPMD symmetry holds
-            # because exits-semantics signals terminate every host's loop
-            # at the same agreed step, and the barrier is only skipped on
-            # steps where NO host gathered. coordinate_every=1 (default)
-            # sidesteps the subtlety entirely.
+        if multihost.process_count() > 1:
+            if step % self.coordinate_every != 0:
+                # defer — acting on the local flag here would either skip
+                # the barrier (per-host saves at divergent steps) or enter
+                # it on a step where unsignaled hosts don't gather
+                return _CODE_NONE, step
             code, step = multihost.agree_emergency(code, step)
-        return code
+        return code, step
 
     def on_step(self, state: Any, step: int | None = None) -> str | None:
         """Drive the autopilot from a training loop, once per step.
@@ -380,15 +425,21 @@ class CheckpointManager:
         kstate, _ = _split_train_state(state)
         if step is None:
             step = _host_step(kstate)
-        code = self._poll_emergency(step)
+        code, agreed_step = self._poll_emergency(step)
         if code != _CODE_NONE:
             local = signals_lib.consume()
-            name = local or (
-                'SIGTERM' if code == _CODE_EXIT else 'SIGUSR1'
-            )
-            path = self.save_emergency(state, reason=name)
+            if code == _CODE_EXIT and (
+                local is None or not signals_lib.exits(local)
+            ):
+                # the pod outranks the local view: another host saw the
+                # exit signal — name the exit cause, not whatever
+                # continue-semantics signal this host happened to catch
+                name = 'SIGTERM'
+            else:
+                name = local or 'SIGUSR1'
+            path = self.save_emergency(state, reason=name, step=agreed_step)
             if code == _CODE_EXIT:
-                raise Preempted(name, step, path)
+                raise Preempted(name, agreed_step, path)
             return path
         if (
             self.save_interval_steps is not None

@@ -275,6 +275,65 @@ def test_on_step_sigterm_preempts_after_durable_save(tmp_path):
         assert mgr.restore_latest().step == 1
 
 
+def test_multihost_coordination_defers_and_uses_agreed_step(
+    tmp_path, monkeypatch
+):
+    """Multi-host (simulated): barrier participation depends only on the
+    step cadence — an off-cadence local signal is deferred, not gathered;
+    on the cadence step the pod-agreed (max) step names the rotation
+    entry and the Preempted step, and a pod-wide EXIT is reported as
+    SIGTERM even when this host only caught a SIGUSR1."""
+    from kfac_tpu.parallel import multihost
+
+    m, batch, params, reg, kfac = _dense_setup()
+    state, _, _ = _run_steps(kfac, reg, m, params, batch)
+    gathers, barriers = [], []
+    monkeypatch.setattr(multihost, 'process_count', lambda: 2)
+    monkeypatch.setattr(multihost, 'barrier', barriers.append)
+
+    def fake_agree(code, step):
+        # another host is 3 steps ahead and saw the SIGTERM
+        gathers.append((code, step))
+        return max(code, 2), step + 3
+
+    monkeypatch.setattr(multihost, 'agree_emergency', fake_agree)
+    with CheckpointManager(
+        tmp_path, engine=kfac, save_interval_steps=None,
+        coordinate_every=4,
+    ) as mgr:
+        os.kill(os.getpid(), signal_mod.SIGUSR1)
+        # step 3 is off-cadence: no gather, the flag stays pending
+        assert mgr.on_step(state, step=3) is None
+        assert gathers == []
+        assert signals.preemption_requested() == 'SIGUSR1'
+        # step 4 coordinates: pod says EXIT at agreed step 7
+        with pytest.raises(Preempted, match='SIGTERM') as excinfo:
+            mgr.on_step(state, step=4)
+        assert gathers == [(1, 4)]
+        assert excinfo.value.step == 7
+        assert excinfo.value.path == mgr.checkpoint_path(7)
+        assert mgr.latest_step() == 7
+        assert barriers  # rank 0's stale-dir clear is ordered before writes
+
+
+def test_prune_removes_stale_uncommitted_dirs(tmp_path):
+    """A torn corpse (step dir without orbax commit markers) older than
+    the newest committed checkpoint is pruned at the next commit instead
+    of accumulating forever; an uncommitted NEWER dir survives (it may be
+    an async save still in flight)."""
+    m, batch, params, reg, kfac = _dense_setup()
+    state, _, _ = _run_steps(kfac, reg, m, params, batch)
+    mgr = CheckpointManager(
+        tmp_path, engine=kfac, install_signals=(), async_save=False
+    )
+    os.makedirs(os.path.join(mgr.step_dir(0), 'ckpt'))  # crashed attempt
+    os.makedirs(os.path.join(mgr.step_dir(9), 'ckpt'))  # maybe in flight
+    mgr.save(state)  # commits step 1 -> prune runs
+    assert not os.path.exists(mgr.step_dir(0))
+    assert os.path.exists(mgr.step_dir(9))
+    assert mgr.latest_step() == 1
+
+
 def test_save_emergency_reuses_committed_step(tmp_path):
     m, batch, params, reg, kfac = _dense_setup()
     state, _, _ = _run_steps(kfac, reg, m, params, batch)
