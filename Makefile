@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults obs resilience inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults obs tune resilience inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -45,7 +45,15 @@ obs:
 		tests/test_flight_recorder.py -q
 	$(TEST_ENV) $(PY) tools/lint_named_scopes.py
 	$(TEST_ENV) $(PY) tools/lint_metric_keys.py
+	$(TEST_ENV) $(PY) tools/lint_plan_schema.py
 	$(PY) tools/kfac_inspect.py --selftest
+
+# layout autotuner: test suite, the plan-schema doc lint, and the
+# end-to-end kfac_tune pipeline selftest (see docs/AUTOTUNE.md)
+tune:
+	$(TEST_ENV) $(PY) -m pytest tests/test_autotune.py -q
+	$(TEST_ENV) $(PY) tools/lint_plan_schema.py
+	$(TEST_ENV) $(PY) tools/kfac_tune.py --selftest
 
 # preemption-safe training: checkpoint-autopilot suite (includes the
 # slow real-kill subprocess test) and the signal-semantics doc lint

@@ -433,6 +433,9 @@ def run_lm_stage(config_name: str, out_path: str) -> None:
     measured so far."""
     cfg = _LM_CONFIGS[config_name]
     result: dict = {'stage': f'lm_{config_name}', 'run_id': _RUN_ID}
+    tp = _active_plan()
+    if tp is not None:
+        result['tuned_plan'] = tp
     dev = _claim_backend(result, out_path, f'lm_{config_name}')
     on_tpu = dev.platform != 'cpu'
 
@@ -639,6 +642,9 @@ def run_resnet_stage(config_name: str, out_path: str) -> None:
         'stage': config_name, 'run_id': _RUN_ID,
         'model_config': f"{cfg['arch']}_b{cfg['batch']}_{cfg['hw']}px",
     }
+    tp = _active_plan()
+    if tp is not None:
+        result['tuned_plan'] = tp
     dev = _claim_backend(result, out_path, config_name)
     on_tpu = dev.platform != 'cpu'
 
@@ -775,6 +781,29 @@ def _run_stage(
     return status
 
 
+def _active_plan() -> dict | None:
+    """Identity of the tuned layout plan driving this run, if any.
+
+    ``KFAC_TUNE_PLAN=/path/to/plan.json`` (see docs/AUTOTUNE.md) makes
+    bench runs self-describing: the record carries the plan's knobs and
+    fingerprint so A/B throughput numbers can be attributed to a layout.
+    """
+    path = os.environ.get('KFAC_TUNE_PLAN')
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            plan = json.load(f)
+        return {
+            'path': path,
+            'schema': plan.get('schema'),
+            'knobs': plan.get('knobs'),
+            'fingerprint': plan.get('fingerprint'),
+        }
+    except Exception as exc:  # noqa: BLE001 - a bad plan must not kill a run
+        return {'path': path, 'error': f'{type(exc).__name__}: {exc}'}
+
+
 def _read_json(path: str) -> dict:
     try:
         with open(path) as f:
@@ -810,6 +839,8 @@ _HEADLINE_KEYS = (
     # observability-probe fields (docs/OBSERVABILITY.md)
     'metrics_jsonl', 'metrics_compilations', 'metrics_overhead_pct',
     'step_breakdown_ms', 'obs_probe_error',
+    # active tuned layout plan, when KFAC_TUNE_PLAN is set (docs/AUTOTUNE.md)
+    'tuned_plan',
 )
 
 
@@ -826,6 +857,9 @@ def _orchestrate(result: dict) -> None:
         result['platform'] = 'cpu'
         if os.environ.get('JAX_PLATFORMS') != 'cpu':
             result['fallback'] = 'tpu_probe_failed'
+    tp = _active_plan()
+    if tp is not None:
+        result['tuned_plan'] = tp
     _persist(result)
 
     deadline_ts = _T0 + float(os.environ.get('BENCH_DEADLINE_S', '1350'))

@@ -10,8 +10,10 @@ torch.distributed.
 
 from kfac_tpu import compat  # noqa: F401  (installs JAX API shims first)
 from kfac_tpu import checkpoint, enums, health, hyperparams, tracing, warnings
+from kfac_tpu import autotune
 from kfac_tpu import observability
 from kfac_tpu import resilience
+from kfac_tpu.autotune import TunedPlan
 from kfac_tpu.resilience import CheckpointManager, Preempted
 from kfac_tpu.health import HealthConfig, HealthState
 from kfac_tpu.observability import (
@@ -56,10 +58,12 @@ __all__ = [
     'PostmortemWriter',
     'Preempted',
     'Registry',
+    'TunedPlan',
     'health',
     'resilience',
     'TrainState',
     'Trainer',
+    'autotune',
     'checkpoint',
     'default_compute_method',
     'enums',

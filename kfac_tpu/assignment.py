@@ -105,6 +105,24 @@ def grad_worker_count(
     return count
 
 
+def candidate_fractions(world_size: int) -> tuple[float, ...]:
+    """All gradient-worker fractions realizable on ``world_size`` devices.
+
+    The divisor structure :func:`grad_worker_count` validates against IS
+    the KAISA candidate space: every divisor c of the world gives one
+    legal grid (c rows x world/c columns). Returned descending — COMM-OPT
+    (1.0) first, MEM-OPT (1/world) last — the enumeration order of the
+    autotuner's search grid (kfac_tpu/autotune/search.py).
+    """
+    if world_size < 1:
+        raise ValueError('world_size must be >= 1')
+    return tuple(
+        c / world_size
+        for c in range(world_size, 0, -1)
+        if world_size % c == 0
+    )
+
+
 def strategy_for_fraction(
     world_size: int,
     grad_worker_fraction: float,

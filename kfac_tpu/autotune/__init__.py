@@ -1,0 +1,60 @@
+"""Layout autotuner: cost model + measured search over the KAISA knobs.
+
+Three layers (see docs/AUTOTUNE.md):
+
+- :mod:`kfac_tpu.autotune.model` — analytic per-candidate step-cost
+  model from the engine's static layout (shares the byte accounting of
+  ``observability/comms.py``), with an HBM feasibility budget;
+- :mod:`kfac_tpu.autotune.search` — candidate enumeration over the
+  divisor/granularity/transport/cadence grid, model ranking, and timed
+  trials of real ``DistributedKFAC`` instantiations;
+- :mod:`kfac_tpu.autotune.plan` — the versioned ``TunedPlan`` JSON
+  artifact consumed by ``DistributedKFAC(auto_layout=...)`` /
+  ``Trainer(auto_layout=...)`` and written by ``tools/kfac_tune.py``.
+"""
+
+from kfac_tpu.autotune.model import (
+    Candidate,
+    HardwareSpec,
+    StaticLayout,
+    candidate_config,
+    predict,
+)
+from kfac_tpu.autotune.plan import (
+    KNOB_KEYS,
+    PLAN_KEYS,
+    PLAN_SCHEMA_VERSION,
+    TunedPlan,
+    apply_knobs,
+    fingerprint_matches,
+    plan_fingerprint,
+    plan_schema_keys,
+    resolve_auto_layout,
+)
+from kfac_tpu.autotune.search import (
+    autotune,
+    baseline_candidates,
+    enumerate_candidates,
+    measure_candidate,
+)
+
+__all__ = [
+    'Candidate',
+    'HardwareSpec',
+    'KNOB_KEYS',
+    'PLAN_KEYS',
+    'PLAN_SCHEMA_VERSION',
+    'StaticLayout',
+    'TunedPlan',
+    'apply_knobs',
+    'autotune',
+    'baseline_candidates',
+    'candidate_config',
+    'enumerate_candidates',
+    'fingerprint_matches',
+    'measure_candidate',
+    'plan_fingerprint',
+    'plan_schema_keys',
+    'predict',
+    'resolve_auto_layout',
+]

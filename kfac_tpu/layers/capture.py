@@ -34,17 +34,26 @@ def _make_gtap(helper: helpers_lib.LayerHelper) -> Callable[..., jax.Array]:
     """Identity on ``y`` whose vjp emits the layer G factor into ``gstat``."""
 
     @jax.custom_vjp
-    def gtap(y: jax.Array, gstat: jax.Array) -> jax.Array:
+    def gtap(y: jax.Array, gstat: Any) -> jax.Array:
         del gstat
         return y
 
-    def fwd(y: jax.Array, gstat: jax.Array):
+    def fwd(y: jax.Array, gstat: Any):
         del gstat
         return y, None
 
     def bwd(_, ybar: jax.Array):
-        # weighted (routed) helpers emit w_i * G_i so repeated
-        # invocations sum traffic-weighted (see g_factor_for_sum)
+        # weighted (routed) helpers emit (w_i * G_i, w_i) with the
+        # weight derived from the COTANGENT's live rows (matching
+        # routed_linear_g_factor's own row detection), so repeated
+        # invocations sum traffic-weighted and the divisor tracks G
+        # mass rather than input mass (see g_factor_for_sum /
+        # g_capture_weight)
+        if helper.weighted:
+            return ybar, (
+                helper.g_factor_for_sum(ybar),
+                helper.g_capture_weight(ybar),
+            )
         return ybar, helper.g_factor_for_sum(ybar)
 
     gtap.defvjp(fwd, bwd)
@@ -72,11 +81,22 @@ class CurvatureCapture:
             for name, helper in registry.layers.items()
         }
 
-    def zero_gstats(self) -> dict[str, jax.Array]:
-        """Zero dummy arguments whose gradients are the G factors."""
+    def zero_gstats(self) -> dict[str, Any]:
+        """Zero dummy arguments whose gradients are the G factors.
+
+        Weighted (routed) helpers get a ``(factor, weight)`` pair so the
+        g-tap can route out the cotangent live fraction next to the
+        weighted G sum; the pairing is static per helper, so the pytree
+        structure is stable across steps.
+        """
+        def zero(h: helpers_lib.LayerHelper):
+            fac = jnp.zeros(h.g_factor_shape, dtype=h.factor_dtype)
+            if h.weighted:
+                return (fac, jnp.zeros((), dtype=h.factor_dtype))
+            return fac
+
         return {
-            name: jnp.zeros(h.g_factor_shape, dtype=h.factor_dtype)
-            for name, h in self.registry.layers.items()
+            name: zero(h) for name, h in self.registry.layers.items()
         }
 
     def tapped(
@@ -160,9 +180,10 @@ class CurvatureCapture:
             (loss, (aux, a_stats, counts, weights)), (grads, g_stats) = (
                 grad_fn(params, gstats_in, *args, **kwargs)
             )
+            g_sums, g_weights = split_g_stats(g_stats)
             a_avg = weighted_average(a_stats, counts, weights)
             g_avg = weighted_average(
-                {n: g_stats[n] for n in a_stats}, counts, weights
+                {n: g_sums[n] for n in a_stats}, counts, g_weights
             )
             w_avg = {
                 n: weights[n] / counts[n].astype(weights[n].dtype)
@@ -234,6 +255,27 @@ class CapturedStats:
 # factor 0 with weight 0 (the EMA then ignores it) instead of dividing
 # 0/0. Shared by every averaging site so the convention cannot drift.
 WEIGHT_FLOOR = 1e-8
+
+
+def split_g_stats(
+    g_stats: dict[str, Any],
+) -> tuple[dict[str, jax.Array], dict[str, jax.Array]]:
+    """Split g-tap cotangents into (factor sums, G-side weight sums).
+
+    Weighted (routed) helpers route out ``(sum w_i G_i, sum w_i)`` pairs
+    with ``w_i`` the COTANGENT live fraction; unweighted helpers a bare
+    factor sum. Shared by :meth:`CurvatureCapture.value_stats_and_grad`
+    and the EP combined capture so both divide weighted G sums by the
+    same G-side denominator.
+    """
+    sums: dict[str, jax.Array] = {}
+    g_weights: dict[str, jax.Array] = {}
+    for n, v in g_stats.items():
+        if isinstance(v, tuple):
+            sums[n], g_weights[n] = v
+        else:
+            sums[n] = v
+    return sums, g_weights
 
 
 def weighted_average(

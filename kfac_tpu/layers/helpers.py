@@ -68,10 +68,26 @@ class LayerHelper:
         Equals :meth:`get_g_factor` for unweighted helpers. Weighted
         (routed) helpers return the factor PRE-SCALED by its own live
         fraction, so summing invocations and dividing by the summed
-        weights yields the traffic-weighted mean ``sum(w_i G_i)/sum(w_i)``
-        — the same convention as cross-micro-step accumulation.
+        G-side weights (:meth:`g_capture_weight`) yields the
+        traffic-weighted mean ``sum(w_i G_i)/sum(w_i)`` — the same
+        convention as cross-micro-step accumulation.
         """
         return self.get_g_factor(g)
+
+    def g_capture_weight(self, g: jax.Array) -> jax.Array | None:
+        """Per-capture G-side evidence weight, from the COTANGENT.
+
+        ``None`` (implicit weight 1) unless :attr:`weighted`. Routed
+        helpers return the cotangent live-row fraction — the same row
+        detection ``routed_linear_g_factor`` normalizes by — so the
+        G-sum divisor tracks the rows that actually carried G mass. The
+        A-side :meth:`capture_weight` is NOT a valid G divisor: an
+        all-zero-input invocation can still see a nonzero cotangent
+        (e.g. through a bias path), and dividing its G sum by the ~0
+        input weight would amplify that spurious mass unboundedly.
+        """
+        del g
+        return None
 
     def get_g_factor(self, g: jax.Array) -> jax.Array:
         """Per-batch G factor from dL/d(layer output) (backward tap)."""
@@ -141,6 +157,11 @@ class DenseHelper(LayerHelper):
         if self.routed:
             return cov.linear_g_factor(g, dtype=self.factor_dtype)
         return self.get_g_factor(g)
+
+    def g_capture_weight(self, g: jax.Array) -> jax.Array | None:
+        if not self.routed:
+            return None
+        return cov.routed_live_fraction(g).astype(self.factor_dtype)
 
     def grads_to_matrix(self, grads: dict[str, jax.Array]) -> jax.Array:
         mat = grads['kernel'].T

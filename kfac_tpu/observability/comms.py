@@ -182,9 +182,18 @@ def comms_summary(engine: Any) -> dict[str, Any]:
 
     The host-side counterpart of the in-jit metrics: everything here is
     static per configuration. ``engine.comms_report()`` is the public
-    entry point.
+    entry point; the autotuner's mesh-less ``StaticLayout``
+    (kfac_tpu/autotune/model.py) satisfies the same attribute surface —
+    carrying ``n_cols`` directly instead of a mesh — so the cost model
+    and the engine share this one byte-accounting implementation.
     """
-    from kfac_tpu.parallel import mesh as mesh_lib
+    mesh = getattr(engine, 'mesh', None)
+    if mesh is not None:
+        from kfac_tpu.parallel import mesh as mesh_lib
+
+        n_cols = mesh_lib.n_cols(mesh)
+    else:
+        n_cols = int(engine.n_cols)
 
     padding = padding_report(engine)
     return {
@@ -192,7 +201,7 @@ def comms_summary(engine: Any) -> dict[str, Any]:
         'grad_worker_fraction': engine.grad_workers / engine.world,
         'devices': engine.total_devices,
         'grad_workers': engine.grad_workers,
-        'n_cols': mesh_lib.n_cols(engine.mesh),
+        'n_cols': n_cols,
         'stat_transport': transport_report(engine),
         'grad_broadcast_bytes': grad_broadcast_bytes(engine),
         'decomp_reshard_bytes': decomp_reshard_bytes(engine),

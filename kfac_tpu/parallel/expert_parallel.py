@@ -459,12 +459,14 @@ def combined_value_stats_and_grad(
         )
         # interceptor stats average over repeated module calls (weight
         # sharing) via the shared convention (capture_lib.weighted_average:
-        # weighted layers divide by summed traffic weight, others by
-        # invocation count); EP stats are already normalized in-body
+        # weighted layers divide by summed traffic weight — A-side from
+        # the inputs, G-side from the cotangents — others by invocation
+        # count); EP stats are already normalized in-body
+        g_sums, g_wts = capture_lib.split_g_stats(flax_g)
         a_all = dict(capture_lib.weighted_average(fa, counts, wts))
         g_all = dict(
             capture_lib.weighted_average(
-                {n: flax_g[n] for n in fa}, counts, wts
+                {n: g_sums[n] for n in fa}, counts, g_wts
             )
         )
         w_all: dict[str, jax.Array] = {

@@ -183,6 +183,46 @@ def build_side_buckets(
     ]
 
 
+def build_stores(
+    registry: registry_lib.Registry,
+    total_devices: int,
+    granularity: int,
+    colocate: bool,
+    buckets: list[Bucket],
+) -> tuple[list[StorageBucket], list[StorageBucket]]:
+    """Factor STORAGE layout (A store, G store) for a configuration.
+
+    Colocated stores mirror the (da, dg) pair buckets (A and G share a
+    slot/device); non-colocated stores bucket each side by its own
+    dimension so a layer's two eigendecompositions can run on different
+    devices (reference kfac/assignment.py:268-304). Pure host-side shape
+    arithmetic — shared by ``DistributedKFAC.__post_init__`` and the
+    autotuner's mesh-less ``StaticLayout`` (kfac_tpu/autotune/model.py)
+    so the analytic cost model prices exactly the layout the engine
+    would build.
+    """
+    if colocate:
+        a_store = [
+            StorageBucket(
+                b.key, b.layers, b.da, b.padded,
+                tuple(d[0] for d in b.dims),
+            )
+            for b in buckets
+        ]
+        g_store = [
+            StorageBucket(
+                b.key, b.layers, b.dg, b.padded,
+                tuple(d[1] for d in b.dims),
+            )
+            for b in buckets
+        ]
+        return a_store, g_store
+    return (
+        build_side_buckets(registry, total_devices, 'a', granularity),
+        build_side_buckets(registry, total_devices, 'g', granularity),
+    )
+
+
 class DistKFACState(NamedTuple):
     """Stacked K-FAC state: bucket key -> (L, d, d) arrays.
 
@@ -233,13 +273,35 @@ class DistributedKFAC:
         config: hyperparameter/config carrier (cadences, damping, decay,
             kl_clip, lr, compute_method, dtypes are read from it).
         mesh: mesh from :func:`kfac_tpu.parallel.mesh.kaisa_mesh`; its shape
-            encodes the gradient worker fraction.
+            encodes the gradient worker fraction. ``None`` builds the
+            default COMM-OPT mesh — or the tuned plan's mesh when
+            ``auto_layout`` applies.
+        auto_layout: a :class:`kfac_tpu.autotune.TunedPlan` (or a path to
+            one) from ``tools/kfac_tune.py``. When its topology+model
+            fingerprint matches this process, the plan's knobs override
+            the config's layout fields and, if no ``mesh`` was given, the
+            plan's gradient-worker fraction picks the mesh; on a mismatch
+            the plan is ignored with a rate-limited
+            :class:`~kfac_tpu.warnings.LayoutPlanWarning`.
     """
 
     config: KFACPreconditioner
-    mesh: Any
+    mesh: Any = None
+    auto_layout: Any = None
 
     def __post_init__(self) -> None:
+        if self.auto_layout is not None:
+            from kfac_tpu.autotune import plan as plan_lib
+
+            self.config, self.mesh, self.auto_layout_applied = (
+                plan_lib.resolve_auto_layout(
+                    self.config, self.mesh, self.auto_layout
+                )
+            )
+        else:
+            self.auto_layout_applied = False
+        if self.mesh is None:
+            self.mesh = mesh_lib.kaisa_mesh()
         self.registry = self.config.registry
         # The KAISA strategy grid is the data-parallel mesh portion, but the
         # eigendecomposition work and factor storage shard over EVERY mesh
@@ -266,32 +328,10 @@ class DistributedKFAC:
             grad_worker_fraction=self.grad_workers / self.world,
             colocate_factors=self.colocate,
         )
-        # Factor STORAGE layout: colocated mirrors the (da, dg) pair
-        # buckets (A and G share a slot/device); non-colocated buckets each
-        # side by its own dimension so a layer's two eigendecompositions
-        # can run on different devices (reference kfac/assignment.py:268-304).
-        if self.colocate:
-            self.a_store = [
-                StorageBucket(
-                    b.key, b.layers, b.da, b.padded,
-                    tuple(d[0] for d in b.dims),
-                )
-                for b in self.buckets
-            ]
-            self.g_store = [
-                StorageBucket(
-                    b.key, b.layers, b.dg, b.padded,
-                    tuple(d[1] for d in b.dims),
-                )
-                for b in self.buckets
-            ]
-        else:
-            self.a_store = build_side_buckets(
-                self.registry, self.total_devices, 'a', self.granularity
-            )
-            self.g_store = build_side_buckets(
-                self.registry, self.total_devices, 'g', self.granularity
-            )
+        self.a_store, self.g_store = build_stores(
+            self.registry, self.total_devices, self.granularity,
+            self.colocate, self.buckets,
+        )
         self._a_slot = {
             n: (sb.key, i)
             for sb in self.a_store

@@ -61,6 +61,13 @@ class Trainer:
             preemption-signal emergency flushes ride the training loop
             with no extra plumbing; :meth:`restore_latest` resumes from
             its rotation.
+        auto_layout: a :class:`kfac_tpu.autotune.TunedPlan` (or a path to
+            one) from ``tools/kfac_tune.py``. Requires ``kfac`` to be a
+            bare :class:`kfac_tpu.KFACPreconditioner` config: the Trainer
+            builds the :class:`~kfac_tpu.parallel.DistributedKFAC` itself
+            so the plan can pick both the config knobs and the mesh. A
+            fingerprint mismatch falls back to the default layout with a
+            rate-limited :class:`~kfac_tpu.warnings.LayoutPlanWarning`.
     """
 
     loss_fn: Callable[..., Any]
@@ -70,8 +77,28 @@ class Trainer:
     factor_update_steps: int = 1
     donate_state: bool = False
     checkpoints: Any = None
+    auto_layout: Any = None
 
     def __post_init__(self) -> None:
+        if self.auto_layout is not None:
+            if self.kfac is None:
+                raise ValueError(
+                    'Trainer(auto_layout=...) requires kfac: the plan '
+                    'configures a KFAC preconditioner'
+                )
+            if hasattr(self.kfac, 'mesh'):
+                raise ValueError(
+                    'Trainer(auto_layout=...) takes the bare '
+                    'KFACPreconditioner config, not a built engine — the '
+                    'plan must pick the mesh; pass '
+                    'DistributedKFAC(config, auto_layout=plan) yourself '
+                    'to combine a plan with an explicit mesh'
+                )
+            from kfac_tpu.parallel.kaisa import DistributedKFAC
+
+            self.kfac = DistributedKFAC(
+                config=self.kfac, auto_layout=self.auto_layout
+            )
         # Host-side mirror of kfac_state.step, used only for cadence
         # dispatch. None = not yet synced: the first step()/step_accumulate()
         # reads the device counter, so a Trainer driving a state restored by

@@ -24,6 +24,12 @@ class CheckpointResilienceWarning(Warning):
     transient I/O) but an operator should know about."""
 
 
+class LayoutPlanWarning(Warning):
+    """A tuned layout plan (kfac_tpu/autotune) could not be applied —
+    topology/model fingerprint mismatch, incompatible mesh — and the
+    engine fell back to its explicit/default configuration."""
+
+
 # (layer, cause) pairs already warned about — each fires ONCE per process,
 # not once per step: a persistently sick layer would otherwise spam the log
 # at training-step frequency while saying nothing new.
@@ -58,3 +64,29 @@ def reset_health_warnings() -> None:
     """Forget emitted health events (tests; or after operator intervention
     so a recurrence warns again)."""
     _health_events_emitted.clear()
+
+
+# plan-fallback causes already warned about — once per process, like the
+# health channel: a stale plan would otherwise warn on every engine (or
+# Trainer) construction in a sweep while saying nothing new.
+_layout_events_emitted: set[str] = set()
+
+
+def warn_layout_event(cause: str, detail: str = '') -> bool:
+    """Emit a rate-limited :class:`LayoutPlanWarning` (once per ``cause``).
+
+    Returns True when a warning was actually emitted."""
+    if cause in _layout_events_emitted:
+        return False
+    _layout_events_emitted.add(cause)
+    msg = f'kfac-tpu autotune: tuned plan not applied — {cause}'
+    if detail:
+        msg += f' ({detail})'
+    msg += '; falling back to the explicit/default layout'
+    _warnings.warn(msg, LayoutPlanWarning, stacklevel=2)
+    return True
+
+
+def reset_layout_warnings() -> None:
+    """Forget emitted plan-fallback events (tests)."""
+    _layout_events_emitted.clear()

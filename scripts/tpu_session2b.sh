@@ -17,7 +17,7 @@ export JAX_COMPILATION_CACHE_DIR="${BENCH_JAX_CACHE:-/tmp/kfac_bench_jax_cache}"
 # Wait for the tunnel to recover from any prior wedge before spending
 # stage budgets: sacrificial 60s probes, up to ~20 min.
 for i in $(seq 1 20); do
-  if timeout 60 python -c 'import jax; d=jax.devices()[0]; print("probe ok:", d.platform)' \
+  if timeout -k 10 60 python -c 'import jax; d=jax.devices()[0]; print("probe ok:", d.platform)' \
       >&2 2>/dev/null; then
     break
   fi

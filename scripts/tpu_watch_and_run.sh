@@ -7,7 +7,10 @@ set -u
 cd "$(dirname "$0")/.."
 
 for i in $(seq 1 80); do   # ~6h at 4.5-minute period
-  if timeout 60 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
+  # -k 10: a wedged tunnel can leave the probe ignoring TERM inside a
+  # blocked device call; KILL it so the watcher keeps polling (same
+  # pattern as run_stage_cmd's `timeout -k 30`)
+  if timeout -k 10 60 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
     echo "watch: tunnel healthy at probe $i ($(date +%H:%M:%S))" >&2
     while pgrep -f '[p]ytest|bench_[a]ccuracy' >/dev/null; do
       echo "watch: host-bound work running; holding stages" >&2

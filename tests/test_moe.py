@@ -546,6 +546,95 @@ def test_multi_invocation_routed_capture_is_traffic_weighted():
     np.testing.assert_allclose(float(stats.w['shared']), 0.5, atol=1e-6)
 
 
+def test_multi_invocation_routed_g_divides_by_cotangent_weight():
+    """G-side counterpart of the A-side caveat test: the starved second
+    invocation sees all-zero INPUT but a fully dense COTANGENT (both
+    invocations' outputs add into the loss), so the G divisor must come
+    from the cotangent live fractions — dividing by the A-side input
+    weight (sum 1.0) would double the captured G."""
+    import flax.linen as nn
+
+    from kfac_tpu.ops import cov
+
+    d = 6
+
+    class TwoCall(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            shared = nn.Dense(4, name='shared')
+            return shared(x).sum(-1) + shared(jnp.zeros_like(x)).sum(-1)
+
+    m = TwoCall()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, d))
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    reg = kfac_tpu.register_model(m, x, routed_layers=['shared'])
+
+    def loss_fn(p, batch):
+        return jnp.mean(m.apply({'params': p}, batch) ** 2)
+
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+    (_, _), _, stats = run(params, x)
+
+    # oracle cotangents, straight from the layer-output computation
+    y1 = x @ params['shared']['kernel'] + params['shared']['bias']
+    y2 = jnp.broadcast_to(params['shared']['bias'], y1.shape)
+    g1, g2 = jax.grad(
+        lambda ys: jnp.mean((ys[0].sum(-1) + ys[1].sum(-1)) ** 2)
+    )((y1, y2))
+    f1 = float(cov.routed_live_fraction(g1))
+    f2 = float(cov.routed_live_fraction(g2))
+    assert f1 == 1.0 and f2 == 1.0  # dense cotangents despite zero input
+    expected = (
+        np.asarray(cov.linear_g_factor(g1)) + np.asarray(cov.linear_g_factor(g2))
+    ) / (f1 + f2)
+    np.testing.assert_allclose(
+        np.asarray(stats.g['shared']), expected, rtol=1e-4, atol=1e-6
+    )
+
+
+def test_fully_starved_routed_g_stays_finite_and_exact():
+    """All-zero input + nonzero cotangent in a SINGLE invocation: the old
+    A-side divisor was the WEIGHT_FLOOR (input live fraction 0), blowing
+    the captured G up by ~1e8; the cotangent-side divisor yields the
+    plain per-row covariance of the cotangent."""
+    import flax.linen as nn
+
+    from kfac_tpu.ops import cov
+
+    d = 6
+
+    class Starved(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4, name='shared')(jnp.zeros_like(x)).sum(-1)
+
+    m = Starved()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, d))
+    params = m.init(jax.random.PRNGKey(1), x)['params']
+    # nonzero bias so the starved layer still emits a nonzero cotangent
+    params = jax.tree.map(lambda v: v, params)
+    params['shared']['bias'] = jnp.ones_like(params['shared']['bias'])
+    reg = kfac_tpu.register_model(m, x, routed_layers=['shared'])
+
+    def loss_fn(p, batch):
+        return jnp.mean(m.apply({'params': p}, batch) ** 2)
+
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+    (_, _), _, stats = run(params, x)
+
+    ybar = jax.grad(
+        lambda y: jnp.mean(y.sum(-1) ** 2)
+    )(jnp.broadcast_to(params['shared']['bias'], (16, 4)))
+    expected = np.asarray(cov.linear_g_factor(ybar))  # live fraction 1
+    assert np.abs(expected).max() > 0
+    g = np.asarray(stats.g['shared'])
+    assert np.all(np.isfinite(g))
+    np.testing.assert_allclose(g, expected, rtol=1e-4, atol=1e-6)
+    # the A side keeps the documented starved convention: factor 0, w 0
+    np.testing.assert_allclose(np.asarray(stats.a['shared']), 0.0, atol=0)
+    np.testing.assert_allclose(float(stats.w['shared']), 0.0, atol=0)
+
+
 def test_weighted_ema_invariants_property_sweep():
     """Property sweep over random weight sequences: (1) w==1 everywhere
     reproduces the plain EMA bitwise-close, (2) w==0 captures are exact

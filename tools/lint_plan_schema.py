@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Drift guard: docs/AUTOTUNE.md's plan-schema table vs the code.
+
+The TunedPlan JSON schema is documented as a table in docs/AUTOTUNE.md
+(section '### Plan schema'). The set of keys the code actually
+serializes is ``kfac_tpu.autotune.plan.plan_schema_keys()`` — the
+top-level plan fields plus one ``knobs.<name>`` entry per knob. This
+lint fails when either side drifts: a field added to the plan without a
+doc row, or a documented field the code no longer produces.
+
+Run directly or via ``make tune`` / ``make obs``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+DOC = 'docs/AUTOTUNE.md'
+SECTION = '### Plan schema'
+
+
+def _doc_section(text: str) -> str:
+    """The plan-schema section body (up to the next heading)."""
+    try:
+        start = text.index(SECTION)
+    except ValueError:
+        raise SystemExit(f'{DOC} has no "{SECTION}" section')
+    rest = text[start + len(SECTION):]
+    nxt = re.search(r'^#{2,3} ', rest, re.MULTILINE)
+    return rest[: nxt.start()] if nxt else rest
+
+
+def doc_keys(doc_path: str = DOC) -> set[str]:
+    with open(doc_path, encoding='utf-8') as f:
+        section = _doc_section(f.read())
+    keys: set[str] = set()
+    for line in section.splitlines():
+        line = line.strip()
+        if not line.startswith('| `'):
+            continue
+        first_cell = line.split('|')[1]
+        keys.update(re.findall(r'`([^`]+)`', first_cell))
+    return keys
+
+
+def code_keys() -> set[str]:
+    from kfac_tpu.autotune import plan as plan_lib
+
+    return set(plan_lib.plan_schema_keys())
+
+
+def check(doc_path: str = DOC) -> list[str]:
+    documented = doc_keys(doc_path)
+    produced = code_keys()
+    complaints = []
+    for k in sorted(produced - documented):
+        complaints.append(f'undocumented plan field (add to {DOC}): {k}')
+    for k in sorted(documented - produced):
+        complaints.append(f'documented field not in the plan schema: {k}')
+    return complaints
+
+
+def main() -> int:
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    os.chdir(repo_root)
+    complaints = check()
+    if complaints:
+        print('\n'.join(complaints))
+        return 1
+    print(
+        f'plan-schema lint ok: {len(doc_keys())} documented fields match '
+        f'kfac_tpu.autotune.plan.plan_schema_keys()'
+    )
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
