@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults obs tune resilience inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults async obs tune resilience inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -37,10 +37,17 @@ test-integration:
 faults:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m faults
 
+# async curvature refresh: double-buffered inverse suite (sliced +
+# host backends, staleness/quarantine/checkpoint semantics); the
+# named-scope lint covers the async entry points too
+async:
+	$(TEST_ENV) $(PY) -m pytest tests/test_async_inverse.py -q
+	$(TEST_ENV) $(PY) tools/lint_named_scopes.py
+
 # telemetry spine: observability + flight-recorder test suites, the
 # named-scope and metric-key-schema lints, and the kfac_inspect
 # analysis selftest (see docs/OBSERVABILITY.md)
-obs:
+obs: async
 	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py \
 		tests/test_flight_recorder.py -q
 	$(TEST_ENV) $(PY) tools/lint_named_scopes.py

@@ -274,6 +274,87 @@ def _timeit(step_for_iter, args, warmup: int = 5, iters: int = 100) -> float:
     return (time.perf_counter() - start) / iters
 
 
+def _async_spike_probe(d: int = 512, window: int = 8, windows: int = 3) -> dict:
+    """Per-step latency series of a d>=512 MLP: synchronous boundary
+    refresh vs the sliced async backend (``kfac_tpu.async_inverse``).
+
+    Builds its own model rather than reusing the stage's — the refresh
+    spike only shows where the boundary eigh (~30 d^3) dominates a step,
+    and the CPU-smoke LM never reaches that regime. Reports p50/p95/max
+    per-step milliseconds for both paths plus ``refresh_spike_ratio``
+    (max step / median step over ``windows`` full cadence windows): the
+    sync path spikes multi-x at every boundary, the sliced path must
+    stay flat (acceptance bar: <= 1.5).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import kfac_tpu
+    from kfac_tpu.models import MLP
+
+    model = MLP(features=(d, d, d), num_classes=32)
+    x = jax.random.normal(jax.random.PRNGKey(3), (256, d))
+    y = jax.random.normal(jax.random.PRNGKey(4), (256, 32))
+
+    def loss(p, batch):
+        xx, yy = batch
+        return jnp.mean((model.apply({'params': p}, xx) - yy) ** 2)
+
+    params = model.init(jax.random.PRNGKey(5), x)['params']
+    reg = kfac_tpu.register_model(model, x)
+    opt = optax.sgd(0.05)
+
+    def series(async_inverse):
+        kfac = kfac_tpu.KFACPreconditioner(
+            registry=reg, damping=1e-3, lr=0.1,
+            factor_update_steps=window, inv_update_steps=window,
+            async_inverse=async_inverse,
+        )
+        run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss)
+
+        @jax.jit
+        def step(p, kstate, opt_state, batch):
+            (l, _), grads, stats = run(p, batch)
+            kstate, pgrads = kfac.step(kstate, grads, stats)
+            updates, opt_state = opt.update(pgrads, opt_state, p)
+            return optax.apply_updates(p, updates), kstate, opt_state, l
+
+        args = (params, kfac.init(), opt.init(params), (x, y))
+        out = None
+        for _ in range(window + 1):  # compile + one full warm window
+            out = step(*args)
+            args = (out[0], out[1], out[2], args[3])
+        jax.block_until_ready(out[3])
+        times = []
+        for _ in range(window * windows):
+            t0 = time.perf_counter()
+            out = step(*args)
+            jax.block_until_ready(out[3])
+            times.append((time.perf_counter() - t0) * 1e3)
+            args = (out[0], out[1], out[2], args[3])
+        return np.asarray(times)
+
+    t_sync = series(None)
+    t_sliced = series('sliced')
+
+    def stats(prefix, ts):
+        return {
+            f'step_p50_ms{prefix}': round(float(np.percentile(ts, 50)), 3),
+            f'step_p95_ms{prefix}': round(float(np.percentile(ts, 95)), 3),
+            f'step_max_ms{prefix}': round(float(np.max(ts)), 3),
+            f'refresh_spike_ratio{prefix}': round(
+                float(np.max(ts) / np.median(ts)), 3
+            ),
+        }
+
+    out = {'async_probe_config': f'mlp_d{d}_b256_w{window}'}
+    out.update(stats('', t_sliced))
+    out.update(stats('_sync', t_sync))
+    return out
+
+
 def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     """Observability probe: per-step metrics JSONL, metrics-on overhead vs
     a metrics-off loop timed back-to-back, and a phase-level step-time
@@ -375,6 +456,13 @@ def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
                     kstate, stats)
     kstate = _phase('inverses_ms', jax.jit(kfac_m.update_inverses), kstate)
     _phase('precondition_ms', jax.jit(kfac_m.precondition), kstate, grads)
+    result['step_breakdown_ms'] = phases
+
+    # async refresh spike probe, after the headline breakdown is safe on
+    # disk — a failure here surfaces as obs_probe_error without losing it
+    _atomic_write(out_path, result)
+    _log('  async refresh spike probe (sync vs sliced, d=512)')
+    phases.update(_async_spike_probe())
     result['step_breakdown_ms'] = phases
 
 

@@ -14,6 +14,7 @@ from kfac_tpu import autotune
 from kfac_tpu import observability
 from kfac_tpu import resilience
 from kfac_tpu.autotune import TunedPlan
+from kfac_tpu.async_inverse import AsyncInverseConfig
 from kfac_tpu.resilience import CheckpointManager, Preempted
 from kfac_tpu.health import HealthConfig, HealthState
 from kfac_tpu.observability import (
@@ -43,6 +44,7 @@ __version__ = '0.1.0'
 __all__ = [
     'AllreduceMethod',
     'AssignmentStrategy',
+    'AsyncInverseConfig',
     'CapturedStats',
     'CheckpointManager',
     'ComputeMethod',

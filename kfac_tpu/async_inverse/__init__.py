@@ -1,0 +1,16 @@
+"""Async curvature refresh: double-buffered inverses off the step path.
+
+See :mod:`kfac_tpu.async_inverse.config` for the model, ``sliced`` for
+the in-step sliced backend, ``host`` for the host-offloaded backend, and
+``slots`` for the shadow-slot state + slice planner.
+"""
+
+from kfac_tpu.async_inverse.config import AsyncInverseConfig, as_async_config
+from kfac_tpu.async_inverse.slots import ShadowSlots, plan_slices
+
+__all__ = [
+    'AsyncInverseConfig',
+    'ShadowSlots',
+    'as_async_config',
+    'plan_slices',
+]

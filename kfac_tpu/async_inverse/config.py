@@ -1,0 +1,86 @@
+"""Configuration for asynchronous (double-buffered) inverse refresh.
+
+The cadence machinery already tolerates stale inverses by design — the
+engine applies the PREVIOUS decomposition for a whole ``inv_update_steps``
+window. Async refresh exploits that tolerance: instead of recomputing every
+decomposition synchronously at the window boundary (a 30*d^3 spike on one
+step), the refresh runs as an overlapped side computation into a *shadow*
+slot and is swapped in atomically at the next boundary. The active
+decompositions a step applies are therefore exactly one window staler than
+the synchronous path's — the same freshness contract, shifted by N steps
+(cf. Distributed Shampoo's asynchronous preconditioner computation, Anil et
+al. 2021, and Osawa et al. 2019's pipelined K-FAC stages).
+
+Two backends:
+
+- ``'sliced'``: the window's decomposition work is split into per-step
+  slices balanced by the n^3 compute weighting
+  (:func:`kfac_tpu.assignment.compute_work_costs`), executed inside the
+  step program. No step absorbs the full eigh cost; everything stays
+  on-device and the swapped results are bit-identical to what the
+  synchronous path would have computed from the same factors.
+- ``'host'``: the whole window's decomposition is shipped to a host worker
+  thread via ``io_callback`` at the boundary, computed with LAPACK while
+  the device keeps stepping, and device_put back for the next boundary's
+  swap. The step program contains no decomposition work at all; results
+  are numerically equivalent (same math, LAPACK vs XLA eigh) but not
+  bit-identical. Requires a host-side driver for the swap — the Trainer
+  drives it on all four step paths; a bare engine stepped without a driver
+  simply keeps applying the last swapped decompositions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+MODES = ('sliced', 'host')
+
+
+@dataclasses.dataclass(frozen=True)
+class AsyncInverseConfig:
+    """Knobs for the async refresh subsystem.
+
+    Args:
+        mode: ``'sliced'`` (in-step sliced refresh) or ``'host'``
+            (host-offloaded refresh). See the module docstring.
+        max_slices: optional cap on the number of per-step slices in
+            ``'sliced'`` mode. By default the planner uses
+            ``min(inv_update_steps, n_units)`` slices (one unit bucket per
+            step); a cap packs more units per slice, finishing the refresh
+            earlier in the window at a higher per-step cost.
+    """
+
+    mode: str = 'sliced'
+    max_slices: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.mode not in MODES:
+            raise ValueError(
+                f'unknown async_inverse mode {self.mode!r}; expected one '
+                f'of {MODES}'
+            )
+        if self.max_slices is not None and self.max_slices < 1:
+            raise ValueError(
+                f'max_slices must be >= 1 (or None), got {self.max_slices}'
+            )
+
+
+def as_async_config(value: Any) -> AsyncInverseConfig | None:
+    """Normalize the ``async_inverse=`` constructor surface.
+
+    Accepts ``None`` (disabled), a mode string (``'sliced'``/``'host'``),
+    ``True`` (sliced defaults), or an :class:`AsyncInverseConfig`.
+    """
+    if value is None or value is False:
+        return None
+    if value is True:
+        return AsyncInverseConfig()
+    if isinstance(value, str):
+        return AsyncInverseConfig(mode=value)
+    if isinstance(value, AsyncInverseConfig):
+        return value
+    raise TypeError(
+        'async_inverse must be an AsyncInverseConfig, a mode string '
+        f'({MODES}), True, False, or None; got {value!r}'
+    )

@@ -1,0 +1,438 @@
+"""Host-offloaded async refresh: the window's decompositions on a worker thread.
+
+Extends the spirit of ``batched_eigh(impl='host')`` into a truly
+asynchronous path. At each window boundary the step program ships the
+freshly-updated factors (and the effective dampings they should be
+decomposed at) to a host worker thread via ``io_callback`` — the device
+keeps stepping while LAPACK does the eigh/inverse work on the host. The
+worker ``device_put``s the finished payload back (an async transfer into
+what is conceptually the shadow slot); at the NEXT boundary the Trainer
+promotes it atomically through the same swap cores the sliced backend
+uses, so health gating, quarantine discard, and ``last_inv_step``
+accounting are identical.
+
+The step program itself contains no decomposition work at all — only the
+step-0 synchronous cold start and the boundary launch callback. Results
+are numerically equivalent to the synchronous path (same math, LAPACK vs
+XLA eigh) but not bit-identical, and the active decompositions are one
+window staler — the same staleness contract as the sliced backend.
+
+Driving: the Trainer pumps the worker on every step path. ``pump`` with a
+step number applies only at window boundaries (blocking until the
+in-flight refresh lands, preserving the boundary-atomic swap); ``pump``
+without one (the scan paths, where the host cannot intervene mid-scan)
+applies any completed payload at scan entry. An engine stepped without a
+driver never swaps — it simply keeps applying the last promoted
+decompositions, growing stale but never torn.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_tpu import enums
+from kfac_tpu import tracing
+from kfac_tpu.async_inverse import sliced as sliced_lib
+
+
+class HostRefreshWorker:
+    """A daemon thread running decomposition jobs off the step path.
+
+    ``submit`` (called from an ``io_callback``) enqueues a job and returns
+    immediately; the thread computes and keeps the LATEST completed
+    payload (an overwritten result means the driver skipped a window —
+    the fresher decomposition wins). ``take`` drains it, optionally
+    blocking until the in-flight job lands (the boundary-pump case).
+    ``reset`` invalidates in-flight work after a checkpoint restore.
+    """
+
+    def __init__(self, compute: Callable[..., Any]):
+        self._compute = compute
+        self._jobs: queue.Queue = queue.Queue()
+        self._cv = threading.Condition()
+        self._pending = 0
+        self._result: Any = None
+        self._epoch = 0
+        self._last_step = -1
+        self._error: BaseException | None = None
+        self._thread: threading.Thread | None = None
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name='kfac-async-refresh', daemon=True
+            )
+            self._thread.start()
+
+    def submit(self, *args) -> None:
+        # io_callback may hand us buffers the runtime reuses — copy now
+        args = jax.tree.map(np.array, args)
+        with self._cv:
+            self._pending += 1
+            epoch = self._epoch
+        self._jobs.put((epoch, args))
+        self._ensure_thread()
+
+    def _run(self) -> None:
+        while True:
+            epoch, args = self._jobs.get()
+            # by convention the first submit arg is the launch step; the
+            # boundary callbacks are unordered (see the launch sites), so
+            # guard against an older window's job landing after a newer one
+            step = int(np.asarray(args[0]))
+            out, err = None, None
+            try:
+                out = self._compute(*args)
+            except BaseException as e:  # surfaced on the next take()
+                err = e
+            with self._cv:
+                self._pending -= 1
+                if epoch == self._epoch:
+                    if err is not None:
+                        self._error = err
+                    elif out is not None and step >= self._last_step:
+                        self._result = out
+                        self._last_step = step
+                self._cv.notify_all()
+
+    def has_work(self) -> bool:
+        with self._cv:
+            return (
+                self._pending > 0
+                or self._result is not None
+                or self._error is not None
+            )
+
+    def take(self, wait: bool = False, timeout: float = 300.0) -> Any:
+        """The latest completed payload, or None if nothing has landed.
+
+        With ``wait=True``, blocks until the in-flight job finishes (the
+        window-boundary pump must not swap a torn refresh, so it waits for
+        the whole payload). Worker exceptions re-raise here.
+        """
+        with self._cv:
+            if wait:
+                self._cv.wait_for(
+                    lambda: self._pending == 0, timeout=timeout
+                )
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise RuntimeError(
+                    'async inverse host refresh failed'
+                ) from err
+            if self._pending > 0 and not wait:
+                return None
+            result, self._result = self._result, None
+            return result
+
+    def reset(self) -> None:
+        """Discard in-flight and completed work (post-restore: the factors
+        that produced it no longer match the restored state)."""
+        with self._cv:
+            self._epoch += 1
+            self._result = None
+            self._error = None
+            self._last_step = -1
+
+
+def _worker(engine, compute_builder) -> HostRefreshWorker:
+    if engine._async_worker is None:
+        engine._async_worker = HostRefreshWorker(compute_builder(engine))
+    return engine._async_worker
+
+
+def reset_worker(engine) -> None:
+    w = getattr(engine, '_async_worker', None)
+    if w is not None:
+        w.reset()
+
+
+# --------------------------------------------------------------------- dense
+
+
+def _dense_compute(engine):
+    """Host-side refresh for the dense engine: numpy LAPACK, fp32.
+
+    Mirrors ``update_inverses``: eigh with eigenvalues clipped to >= 0
+    (PSD factors; tiny negative eigenvalues are roundoff), fused prediv
+    ``1 / (outer(dg, da) + eff)``, or the damped INVERSE path. Payloads
+    are ``device_put`` from the worker thread so the transfer overlaps
+    training and the boundary apply finds the data already on device.
+    """
+    eigen = engine.compute_method == enums.ComputeMethod.EIGEN
+    prediv = engine.prediv_eigenvalues
+    fields = sliced_lib.decomp_fields(
+        engine.compute_method, engine.prediv_eigenvalues
+    )
+
+    def compute(step, damping, effs, a, g):
+        del step
+        out: dict[str, dict[str, np.ndarray]] = {f: {} for f in fields}
+        for name in a:
+            eff = float(np.asarray(effs[name]))
+            fa = np.asarray(a[name], np.float32)
+            fg = np.asarray(g[name], np.float32)
+            if eigen:
+                wa, va = np.linalg.eigh(fa)
+                wg, vg = np.linalg.eigh(fg)
+                wa = np.clip(wa, 0.0, None)
+                wg = np.clip(wg, 0.0, None)
+                out['qa'][name] = va
+                out['qg'][name] = vg
+                if prediv:
+                    out['dgda'][name] = (
+                        1.0 / (np.outer(wg, wa) + eff)
+                    ).astype(np.float32)
+                else:
+                    out['da'][name] = wa
+                    out['dg'][name] = wg
+            else:
+                eye_a = np.eye(fa.shape[0], dtype=np.float32)
+                eye_g = np.eye(fg.shape[0], dtype=np.float32)
+                out['a_inv'][name] = np.linalg.inv(fa + eff * eye_a)
+                out['g_inv'][name] = np.linalg.inv(fg + eff * eye_g)
+        return {
+            'fields': jax.tree.map(jax.device_put, out),
+            'damping': float(np.asarray(damping)),
+        }
+
+    return compute
+
+
+@tracing.scope('kfac.async_host_launch')
+def dense_host_step(engine, state):
+    """The dense engine's in-jit host-mode stage: cold start + boundary
+    launch. No decomposition work runs on-device after step 0."""
+    from jax.experimental import io_callback
+
+    worker = _worker(engine, _dense_compute)
+    state = jax.lax.cond(
+        state.step == 0, engine.update_inverses, lambda s: s, state
+    )
+
+    def launch(s):
+        damping = sliced_lib._resolve(engine.damping, s.step)
+        if engine.health is None:
+            effs = {
+                n: jnp.asarray(damping, jnp.float32)
+                for n in engine.registry.layers
+            }
+        else:
+            effs = {
+                n: jnp.asarray(
+                    damping * s.health.damping_mult[n], jnp.float32
+                )
+                for n in engine.registry.layers
+            }
+        # ordered=True hard-crashes XLA's sharding propagation when the
+        # callback sits inside a lax.cond branch with sharded operands
+        # (sharding_propagation.cc CHECK on the parameter-propagation
+        # vector); unordered callbacks compile and still fire only when
+        # the branch is taken. The worker's step guard restores ordering.
+        io_callback(
+            worker.submit, None,
+            s.step, jnp.asarray(damping, jnp.float32), effs, s.a, s.g,
+            ordered=False,
+        )
+        return s
+
+    return jax.lax.cond(
+        jnp.mod(state.step, engine._async_n_steps) == 0,
+        launch, lambda s: s, state,
+    )
+
+
+def dense_apply(engine, state, payload):
+    """Promote a completed host payload through the shared swap core."""
+    cand = {
+        f: {
+            n: jnp.asarray(v).astype(engine.inv_dtype)
+            for n, v in d.items()
+        }
+        for f, d in payload['fields'].items()
+    }
+    return sliced_lib.dense_swap_core(engine, state, cand, complete=True)
+
+
+# --------------------------------------------------------------- distributed
+
+
+def _kaisa_compute(engine):
+    """Host-side refresh for the distributed engine: batched numpy LAPACK
+    over the full stacked slots (the host sees the gathered stacks)."""
+    cfg = engine.config
+    eigen = engine._eigen
+    prediv = engine._prediv
+    fields = sliced_lib.decomp_fields(cfg.compute_method, prediv)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(engine.mesh, PartitionSpec())
+
+    def compute(step, damping, dmp_a, dmp_g, dmp_pair, a, g):
+        del step
+        out: dict[str, dict[str, np.ndarray]] = {f: {} for f in fields}
+        if eigen:
+            d_a: dict[str, np.ndarray] = {}
+            d_g: dict[str, np.ndarray] = {}
+            for key, stack in a.items():
+                w, v = np.linalg.eigh(np.asarray(stack, np.float32))
+                d_a[key] = np.clip(w, 0.0, None)
+                out['qa'][key] = v
+                if not prediv:
+                    out['da'][key] = d_a[key]
+            for key, stack in g.items():
+                w, v = np.linalg.eigh(np.asarray(stack, np.float32))
+                d_g[key] = np.clip(w, 0.0, None)
+                out['qg'][key] = v
+                if not prediv:
+                    out['dg'][key] = d_g[key]
+            if prediv:
+                for key, dmp in dmp_pair.items():
+                    dmp = np.asarray(dmp, np.float32)
+                    out['dgda'][key] = (
+                        1.0
+                        / (
+                            d_g[key][:, :, None] * d_a[key][:, None, :]
+                            + dmp[:, None, None]
+                        )
+                    ).astype(np.float32)
+        else:
+            for key, stack in a.items():
+                f32 = np.asarray(stack, np.float32)
+                dmp = np.asarray(dmp_a[key], np.float32)
+                eye = np.eye(f32.shape[-1], dtype=np.float32)
+                out['a_inv'][key] = np.linalg.inv(
+                    f32 + dmp[:, None, None] * eye
+                )
+            for key, stack in g.items():
+                f32 = np.asarray(stack, np.float32)
+                dmp = np.asarray(dmp_g[key], np.float32)
+                eye = np.eye(f32.shape[-1], dtype=np.float32)
+                out['g_inv'][key] = np.linalg.inv(
+                    f32 + dmp[:, None, None] * eye
+                )
+        return {
+            'fields': jax.tree.map(
+                lambda x: jax.device_put(x, rep), out
+            ),
+            'damping': float(np.asarray(damping)),
+        }
+
+    return compute
+
+
+@tracing.scope('dist_kfac.async_host_launch')
+def kaisa_host_step(engine, state):
+    """The distributed engine's in-jit host-mode stage."""
+    from jax.experimental import io_callback
+
+    worker = _worker(engine, _kaisa_compute)
+    cfg = engine.config
+    state = jax.lax.cond(
+        state.step == 0, engine.update_inverses, lambda s: s, state
+    )
+
+    def launch(s):
+        damping = sliced_lib._resolve(cfg.damping, s.step)
+
+        def slot_dmp(layers, padded):
+            if cfg.health is None:
+                base = jnp.asarray(damping, jnp.float32)
+            else:
+                base = jnp.asarray(
+                    damping * engine._slot_mults(s.health, layers, padded),
+                    jnp.float32,
+                )
+            return jnp.broadcast_to(base, (padded,))
+
+        dmp_a = {
+            sb.key: slot_dmp(sb.layers, sb.padded) for sb in engine.a_store
+        }
+        dmp_g = {
+            sb.key: slot_dmp(sb.layers, sb.padded) for sb in engine.g_store
+        }
+        dmp_pair = (
+            {b.key: slot_dmp(b.layers, b.padded) for b in engine.buckets}
+            if engine._prediv else {}
+        )
+        # unordered for the same XLA cond+sharded-operand crash as the
+        # dense launch; the worker's step guard restores ordering
+        io_callback(
+            worker.submit, None,
+            s.step, jnp.asarray(damping, jnp.float32),
+            dmp_a, dmp_g, dmp_pair, s.a, s.g,
+            ordered=False,
+        )
+        return s
+
+    return jax.lax.cond(
+        jnp.mod(state.step, engine._async_n_steps) == 0,
+        launch, lambda s: s, state,
+    )
+
+
+def kaisa_apply(engine, state, payload):
+    """Promote a completed host payload through the shared swap core."""
+    cfg = engine.config
+    cand = {
+        f: {
+            k: jnp.asarray(v).astype(cfg.inv_dtype)
+            for k, v in d.items()
+        }
+        for f, d in payload['fields'].items()
+    }
+    return sliced_lib.kaisa_swap_core(
+        engine, state, cand,
+        jnp.asarray(payload['damping'], jnp.float32),
+        complete=True,
+    )
+
+
+# --------------------------------------------------------------------- pump
+
+
+def _apply_fn(engine):
+    fn = getattr(engine, '_async_apply_cache', None)
+    if fn is None:
+        if hasattr(engine, '_sharded_eigh'):  # distributed engine
+            fn = jax.jit(
+                lambda s, p: kaisa_apply(engine, s, p),
+                out_shardings=engine.state_shardings(),
+            )
+        else:
+            fn = jax.jit(lambda s, p: dense_apply(engine, s, p))
+        engine._async_apply_cache = fn
+    return fn
+
+
+@tracing.trace(name='kfac.async_host_pump')
+def pump(engine, state, step: int | None = None):
+    """Host-side driver: promote a completed refresh into the state.
+
+    With ``step``: apply only at a window boundary, blocking until the
+    in-flight refresh lands (swap stays boundary-atomic; the wait is the
+    host analogue of the synchronous spike and is ~0 when the window gave
+    the worker enough time). Without ``step`` (the scan paths — the host
+    cannot intervene mid-scan): apply any already-completed payload,
+    non-blocking. Returns the (possibly swapped) state.
+    """
+    if getattr(engine, '_async_mode', None) != 'host':
+        return state
+    worker = engine._async_worker
+    if worker is None or not worker.has_work():
+        return state
+    if step is not None:
+        if step <= 0 or step % engine._async_n_steps != 0:
+            return state
+        payload = worker.take(wait=True)
+    else:
+        payload = worker.take(wait=False)
+    if payload is None:
+        return state
+    return _apply_fn(engine)(state, payload)

@@ -1,0 +1,97 @@
+"""Shadow-slot state and slice planning for async inverse refresh.
+
+The double buffer: every decomposition field of the engine state
+(``qa``/``qg``/``da``/``dg``/``dgda`` or ``a_inv``/``g_inv``) gets a
+*shadow* twin of identical shape. Slices (or the host worker) write into
+the shadow; the window-boundary swap promotes a complete, finite,
+non-quarantined shadow into the active slots in one gated ``where`` — a
+step program never observes a half-written decomposition.
+
+``ShadowSlots`` is engine-agnostic: the dense engine keys the dicts by
+layer name, the distributed engine by storage-bucket key (stacked slots),
+exactly mirroring the active fields. ``progress`` counts completed slices
+since the last boundary — the swap's completeness gate — and ``damping``
+records the damping the shadow was built at (promoted into the
+distributed engine's ``inv_damping`` at swap time).
+
+Shadow slots are deliberately EPHEMERAL: ``checkpoint.durable_state``
+persists only ``step``/``a``/``g``(+health), so a restore rebuilds the
+active decompositions synchronously (``rematerialize``) and resets the
+shadow to empty. The first boundary after a mid-window restore finds
+``progress < n_slices`` and skips the swap — deterministic, no torn slot.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ShadowSlots(NamedTuple):
+    """Shadow twins of the decomposition fields plus refresh bookkeeping.
+
+    Unused method slots hold empty dicts so the pytree structure is
+    static per-configuration (same contract as the engine state).
+    """
+
+    qa: dict[str, jax.Array]
+    qg: dict[str, jax.Array]
+    da: dict[str, jax.Array]
+    dg: dict[str, jax.Array]
+    dgda: dict[str, jax.Array]
+    a_inv: dict[str, jax.Array]
+    g_inv: dict[str, jax.Array]
+    # completed slices since the last window boundary (int32 scalar)
+    progress: jax.Array
+    # damping the shadow decompositions were built at (f32 scalar); the
+    # distributed engine promotes this into inv_damping at swap time
+    damping: jax.Array
+
+
+def empty_shadow(
+    fields: dict[str, dict[str, jax.Array]],
+) -> ShadowSlots:
+    """A zeroed shadow mirroring ``fields`` (field name -> keyed arrays).
+
+    Fields not present get empty dicts. ``progress`` starts at 0 so the
+    first boundary after init/restore never swaps a never-written shadow.
+    """
+    slots = {
+        f: {k: jnp.zeros_like(v) for k, v in fields.get(f, {}).items()}
+        for f in ('qa', 'qg', 'da', 'dg', 'dgda', 'a_inv', 'g_inv')
+    }
+    return ShadowSlots(
+        progress=jnp.zeros((), jnp.int32),
+        damping=jnp.zeros((), jnp.float32),
+        **slots,
+    )
+
+
+def plan_slices(
+    units: list[tuple[Any, float]],
+    n_slices: int,
+) -> list[list[Any]]:
+    """Greedy longest-processing-time balance of refresh units into slices.
+
+    ``units`` is ``[(key, cost)]`` with cost in the n^3 compute weighting
+    of :func:`kfac_tpu.assignment.compute_work_costs` (eigendecomposition
+    FLOPs — the same heuristic KAISA's greedy placement balances with,
+    reference kfac/assignment.py:227-319). Deterministic: ties break on
+    the unit key's repr, then insertion order, so the slice plan — and
+    therefore the compiled step program — is stable across processes.
+    """
+    if n_slices < 1:
+        raise ValueError(f'n_slices must be >= 1, got {n_slices}')
+    n_slices = min(n_slices, len(units)) or 1
+    order = sorted(
+        enumerate(units), key=lambda iu: (-iu[1][1], repr(iu[1][0]), iu[0])
+    )
+    loads = [0.0] * n_slices
+    slices: list[list[Any]] = [[] for _ in range(n_slices)]
+    for _, (key, cost) in order:
+        tgt = min(range(n_slices), key=lambda i: (loads[i], i))
+        slices[tgt].append(key)
+        loads[tgt] += cost
+    return [s for s in slices if s]

@@ -20,6 +20,10 @@ Cost terms (documented in docs/AUTOTUNE.md):
   factor cadence, decomposition reshard (the inverse broadcast) per
   inverse cadence, gradient broadcast every step (free under COMM-OPT —
   the stacks are already replicated);
+- **refresh spike** — the worst single step's decomposition overshoot,
+  shaped by the ``async_inverse`` knob: the whole refresh lands on one
+  boundary step synchronously, a slice of it per step under 'sliced',
+  and only the boundary payload transfer under 'host';
 - **padding waste** rides implicitly in every term through the padded
   class dims and slot counts;
 - **per-device factor-state memory** against an HBM budget, pruning
@@ -59,6 +63,10 @@ class Candidate:
     factor_update_steps: int = 1
     inv_update_steps: int = 1
     colocate_factors: bool = True
+    # async refresh backend name ('sliced' | 'host') or None for the
+    # synchronous boundary refresh; trailing with a default so existing
+    # positional construction and old plans stay valid
+    async_inverse: str | None = None
 
     def knobs(self, world: int) -> dict[str, Any]:
         """This candidate as a TunedPlan ``knobs`` dict (adds the derived
@@ -74,6 +82,7 @@ class Candidate:
             'factor_update_steps': self.factor_update_steps,
             'inv_update_steps': self.inv_update_steps,
             'colocate_factors': self.colocate_factors,
+            'async_inverse': self.async_inverse,
         }
 
 
@@ -90,6 +99,7 @@ class HardwareSpec:
     matmul_flops: float = 5e12  # sustained per-device matmul FLOP/s
     collective_bandwidth: float = 1e11  # logical payload drain, bytes/s
     hbm_bytes: float | None = None  # per-device factor-state budget
+    host_bandwidth: float = 1e10  # host<->device transfer, bytes/s
 
 
 def candidate_config(base: Any, cand: Candidate) -> Any:
@@ -105,6 +115,7 @@ def candidate_config(base: Any, cand: Candidate) -> Any:
         'factor_update_steps': cand.factor_update_steps,
         'inv_update_steps': cand.inv_update_steps,
         'colocate_factors': cand.colocate_factors,
+        'async_inverse': cand.async_inverse,
     })
 
 
@@ -166,6 +177,16 @@ def _decomp_flops(layout: StaticLayout) -> float:
     ))
 
 
+def _refresh_units(layout: StaticLayout) -> int:
+    """How many independently refreshable decomposition units the layout
+    has — the upper bound on the sliced backend's slice count (mirrors
+    ``async_inverse.sliced.kaisa_units``: one unit per storage bucket,
+    or one per pair bucket under the fused prediv path)."""
+    if layout._prediv:
+        return len(layout.buckets)
+    return len(layout.a_store) + len(layout.g_store)
+
+
 def _precond_flops(layout: StaticLayout) -> float:
     """Global FLOPs of one preconditioning pass over the grad stacks.
 
@@ -206,10 +227,31 @@ def predict(
         + (0 if comm_opt else grad_bytes)
     )
 
-    flops_per_step = (
-        _decomp_flops(layout) / world / cand.inv_update_steps
-        + _precond_flops(layout) / layout.n_cols
-    )
+    # One full inverse refresh, in per-device seconds. Synchronously it
+    # lands on a single boundary step; the async backends reshape it:
+    # 'sliced' spreads the same device work over the window's slices,
+    # 'host' moves the FLOPs off-device entirely and the step only pays
+    # the boundary device_put of the refreshed payload.
+    decomp_dev_flops = _decomp_flops(layout) / world
+    refresh_s = decomp_dev_flops / hardware.matmul_flops
+    host_transfer_s = 0.0
+    if cand.async_inverse == 'host':
+        host_transfer_s = reshard_bytes / hardware.host_bandwidth
+        refresh_spike_s = host_transfer_s
+        flops_per_step = _precond_flops(layout) / layout.n_cols
+    elif cand.async_inverse == 'sliced':
+        n_slices = max(1, min(cand.inv_update_steps, _refresh_units(layout)))
+        refresh_spike_s = refresh_s / n_slices
+        flops_per_step = (
+            decomp_dev_flops / cand.inv_update_steps
+            + _precond_flops(layout) / layout.n_cols
+        )
+    else:
+        refresh_spike_s = refresh_s
+        flops_per_step = (
+            decomp_dev_flops / cand.inv_update_steps
+            + _precond_flops(layout) / layout.n_cols
+        )
 
     factor_item = comms_lib._itemsize(cfg.factor_dtype)
     factor_total = sum(
@@ -249,8 +291,12 @@ def predict(
         'bytes_per_step': bytes_per_step,
         'flops_per_device_per_step': flops_per_step,
         'memory_per_device_bytes': memory,
+        # worst single step's refresh overshoot above steady state — the
+        # latency-jitter term the async backends exist to flatten
+        'refresh_spike_s': refresh_spike_s,
         'predicted_step_s': (
             flops_per_step / hardware.matmul_flops
             + bytes_per_step / hardware.collective_bandwidth
+            + host_transfer_s / cand.inv_update_steps
         ),
     }

@@ -41,7 +41,12 @@ KNOB_KEYS = (
     'factor_update_steps',
     'inv_update_steps',
     'colocate_factors',
+    'async_inverse',
 )
+
+# Knobs added after schema-v1 plans shipped: absent in older documents,
+# filled with these defaults on load so old plans keep applying cleanly.
+OPTIONAL_KNOBS: dict[str, Any] = {'async_inverse': None}
 
 
 def plan_schema_keys() -> tuple[str, ...]:
@@ -126,10 +131,17 @@ class TunedPlan:
                 f'TunedPlan schema {doc["schema"]} is not the supported '
                 f'version {PLAN_SCHEMA_VERSION}'
             )
-        knob_missing = [k for k in KNOB_KEYS if k not in doc['knobs']]
+        knob_missing = [
+            k for k in KNOB_KEYS
+            if k not in doc['knobs'] and k not in OPTIONAL_KNOBS
+        ]
         if knob_missing:
             raise ValueError(f'TunedPlan knobs missing {knob_missing}')
-        return cls(**{k: doc[k] for k in PLAN_KEYS})
+        fields = {k: doc[k] for k in PLAN_KEYS}
+        fields['knobs'] = {
+            **OPTIONAL_KNOBS, **fields['knobs']
+        }
+        return cls(**fields)
 
     def save(self, path: str | os.PathLike[str]) -> None:
         """Atomic write (tmp + rename), stable key order."""
@@ -186,6 +198,8 @@ def apply_knobs(config: Any, knobs: dict[str, Any]) -> Any:
         factor_update_steps=int(knobs['factor_update_steps']),
         inv_update_steps=int(knobs['inv_update_steps']),
         colocate_factors=bool(knobs['colocate_factors']),
+        # normalized by the config's __post_init__ (mode string or None)
+        async_inverse=knobs.get('async_inverse'),
     )
 
 

@@ -15,7 +15,11 @@ winner is never slower than the best hand-configured strategy.
 The inverse cadence defaults to the BASE config's cadence (one value):
 unlike the layout knobs it trades preconditioner freshness, not just
 speed, so the search widens it only when explicitly asked
-(``inv_cadences=...`` / the CLI flag).
+(``inv_cadences=...`` / the CLI flag) — OR when the base config opts
+into async refresh (``async_inverse=``). An async window amortizes the
+refresh off the critical path, so longer cadences stop costing latency
+spikes and become worth enumerating: the grid then widens to
+{c, 2c, 4c} and every candidate carries the base's async mode.
 """
 
 from __future__ import annotations
@@ -37,6 +41,14 @@ def _static_cadence(value: Any, default: int = 1) -> int:
     return int(value) if isinstance(value, int) else default
 
 
+def _async_mode(base: Any) -> str | None:
+    """The base config's async-refresh mode name, or None when it runs
+    the synchronous boundary refresh (accepts both the normalized
+    AsyncInverseConfig and a raw mode string)."""
+    acfg = getattr(base, 'async_inverse', None)
+    return getattr(acfg, 'mode', acfg)
+
+
 def enumerate_candidates(
     world: int,
     base: Any,
@@ -54,8 +66,14 @@ def enumerate_candidates(
             ('ALLREDUCE', None),
             ('ALLREDUCE_BUCKETED', base.allreduce_bucket_cap_mb),
         ]
+    async_mode = _async_mode(base)
     if inv_cadences is None:
-        inv_cadences = (_static_cadence(base.inv_update_steps),)
+        c = _static_cadence(base.inv_update_steps)
+        # async refresh amortizes the window off the critical path, so
+        # longer cadences become free speed rather than latency spikes —
+        # widen the axis only then (freshness is otherwise the user's
+        # explicit call, see the module docstring)
+        inv_cadences = (c, 2 * c, 4 * c) if async_mode else (c,)
     factor_cadence = _static_cadence(base.factor_update_steps)
     out = []
     for frac in fractions:
@@ -76,6 +94,7 @@ def enumerate_candidates(
                             True if workers == 1
                             else bool(base.colocate_factors)
                         ),
+                        async_inverse=async_mode,
                     ))
     return out
 
@@ -119,6 +138,7 @@ def baseline_candidates(world: int, base: Any) -> list[model_lib.Candidate]:
                 if assignment_lib.grad_worker_count(world, f) == 1
                 else bool(base.colocate_factors)
             ),
+            async_inverse=_async_mode(base),
         )
         for f in fracs
     ]

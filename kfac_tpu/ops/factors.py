@@ -89,6 +89,16 @@ def batched_eigh(
     approximate inverse exactly as the reference does. ``jnp.linalg.eig``
     has no TPU lowering, so this path always rides the host callback.
     """
+    # fp32 upcast guard: decompositions NEVER run in half precision. The
+    # module contract ("bf16 eigendecompositions are not stable") is
+    # enforced here rather than trusted to every caller — a bf16/fp16
+    # factor stack (AMP factor_dtype, async shadow payloads) is upcast
+    # before any eigh, device or host, and non-real inputs are rejected.
+    if not jnp.issubdtype(factor.dtype, jnp.floating):
+        raise TypeError(
+            'batched_eigh requires a real floating factor stack; got '
+            f'{jnp.dtype(factor.dtype).name}'
+        )
     f = factor.astype(jnp.float32)
     if impl in ('host', 'eig_host'):
         import numpy as np

@@ -38,6 +38,9 @@ from kfac_tpu import assignment as assignment_lib
 from kfac_tpu import enums
 from kfac_tpu import health as health_lib
 from kfac_tpu import tracing
+from kfac_tpu.async_inverse import host as async_host
+from kfac_tpu.async_inverse import sliced as async_sliced
+from kfac_tpu.async_inverse import slots as async_slots
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
 from kfac_tpu.observability import comms as comms_lib
@@ -263,6 +266,10 @@ class DistKFACState(NamedTuple):
     health: Any = None
     metrics: Any = None
     flight: Any = None
+    # double-buffered shadow decomposition slots when async_inverse mode
+    # 'sliced' is enabled (kfac_tpu/async_inverse); ephemeral like
+    # metrics/flight — a restore rematerializes and resets it
+    shadow: Any = None
 
 
 @dataclasses.dataclass
@@ -363,6 +370,25 @@ class DistributedKFAC:
         # slot's Newton-Schulz residual fails (it used to be a vmapped
         # per-slot cond -> select paying both branches unconditionally,
         # which warranted a TPUPerformanceWarning here).
+        self._plan_async()
+
+    def _plan_async(self) -> None:
+        """Precompute the async refresh plan over the STACKED layout
+        (units are storage buckets — one sharded batched decomposition per
+        slice — not layers; same attribute surface as the dense engine's
+        ``_plan_async``)."""
+        acfg = self.config.async_inverse
+        self._async_mode = None if acfg is None else acfg.mode
+        self._async_worker = None
+        self._async_apply_cache = None
+        if acfg is None:
+            return
+        self._async_n_steps = int(self.config.inv_update_steps)
+        if acfg.mode == 'sliced':
+            units = async_sliced.kaisa_units(self)
+            n = min(self._async_n_steps, acfg.max_slices or len(units))
+            self._async_slices = async_slots.plan_slices(units, n)
+            self._async_n_slices = len(self._async_slices)
 
     # ------------------------------------------------------------ shardings
 
@@ -427,6 +453,25 @@ class DistributedKFAC:
             )
         else:
             flight_sh = None
+        if self._async_mode == 'sliced':
+            from kfac_tpu.async_inverse import slots as _slots
+
+            shadow_sh = _slots.ShadowSlots(
+                qa=adict(dec) if eigen else {},
+                qg=gdict(dec) if eigen else {},
+                da=adict(dec) if eigen and not self._prediv else {},
+                dg=gdict(dec) if eigen and not self._prediv else {},
+                dgda=(
+                    {b.key: dec for b in self.buckets}
+                    if self._prediv else {}
+                ),
+                a_inv={} if eigen else adict(dec),
+                g_inv={} if eigen else gdict(dec),
+                progress=rep,
+                damping=rep,
+            )
+        else:
+            shadow_sh = None
         return DistKFACState(
             step=rep,
             a=adict(fac),
@@ -442,6 +487,7 @@ class DistributedKFAC:
             health=health_sh,
             metrics=metrics_sh,
             flight=flight_sh,
+            shadow=shadow_sh,
         )
 
     # ----------------------------------------------------------------- init
@@ -516,7 +562,17 @@ class DistributedKFAC:
                 ),
             )
 
-        return jax.jit(build, out_shardings=self.state_shardings())()
+        def build_with_shadow() -> DistKFACState:
+            state = build()
+            if self._async_mode == 'sliced':
+                state = state._replace(
+                    shadow=async_sliced.kaisa_shadow(self, state)
+                )
+            return state
+
+        return jax.jit(
+            build_with_shadow, out_shardings=self.state_shardings()
+        )()
 
     # ------------------------------------------------------------- stacking
 
@@ -1274,12 +1330,17 @@ class DistributedKFAC:
                 lambda s: s,
                 state,
             )
-        state = jax.lax.cond(
-            state.step % _resolve(cfg.inv_update_steps, state.step) == 0,
-            self.update_inverses,
-            lambda s: s,
-            state,
-        )
+        if self._async_mode == 'sliced':
+            state = async_sliced.kaisa_async_step(self, state)
+        elif self._async_mode == 'host':
+            state = async_host.kaisa_host_step(self, state)
+        else:
+            state = jax.lax.cond(
+                state.step % _resolve(cfg.inv_update_steps, state.step) == 0,
+                self.update_inverses,
+                lambda s: s,
+                state,
+            )
         if cfg.metrics is not None and state.metrics is not None:
             scal: dict[str, jax.Array] = {}
             new_grads = self.precondition(state, grads, metrics_out=scal)
@@ -1304,8 +1365,20 @@ class DistributedKFAC:
 
     def rematerialize(self, state: DistKFACState) -> DistKFACState:
         """Recompute decompositions from factors after a checkpoint restore
-        (reference semantics: kfac/base_preconditioner.py:296-308)."""
-        return self.update_inverses(state)
+        (reference semantics: kfac/base_preconditioner.py:296-308).
+
+        Under async refresh the shadow is reset (host mode: in-flight
+        worker output discarded) — the first boundary after a mid-window
+        restore skips the swap, the next window refreshes normally.
+        """
+        state = self.update_inverses(state)
+        if self._async_mode == 'sliced':
+            state = state._replace(
+                shadow=async_sliced.kaisa_shadow(self, state)
+            )
+        elif self._async_mode == 'host':
+            async_host.reset_worker(self)
+        return state
 
     def extract_factors(
         self, state: DistKFACState

@@ -30,6 +30,10 @@ from kfac_tpu import enums
 from kfac_tpu import health as health_lib
 from kfac_tpu import tracing
 from kfac_tpu import warnings as kfac_warnings
+from kfac_tpu.async_inverse import config as async_config_lib
+from kfac_tpu.async_inverse import host as async_host
+from kfac_tpu.async_inverse import sliced as async_sliced
+from kfac_tpu.async_inverse import slots as async_slots
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
 from kfac_tpu.observability import flight_recorder as flight_lib
@@ -89,6 +93,10 @@ class KFACState(NamedTuple):
     ``flight``: :class:`kfac_tpu.observability.FlightRecorderState`
     rolling last-N-step telemetry ring when the flight recorder is
     enabled, else ``None`` — same ephemeral contract as ``metrics``.
+    ``shadow``: :class:`kfac_tpu.async_inverse.ShadowSlots` double-buffer
+    twin of the decomposition slots when async inverse refresh is enabled,
+    else ``None`` — ephemeral like ``metrics`` (not checkpointed; restore
+    rematerializes the active decompositions and resets the shadow).
     Unused method slots hold empty dicts so the pytree structure is static
     per-configuration.
     """
@@ -106,6 +114,7 @@ class KFACState(NamedTuple):
     health: Any = None
     metrics: Any = None
     flight: Any = None
+    shadow: Any = None
 
 
 @dataclasses.dataclass
@@ -247,6 +256,20 @@ class KFACPreconditioner:
     # by both engines and all Trainer step paths (the Trainer supplies
     # the loss).
     flight: 'flight_lib.FlightRecorderConfig | bool | int | None' = None
+    # Async inverse refresh (kfac_tpu/async_inverse, docs/ARCHITECTURE.md):
+    # double-buffered active/shadow decomposition slots where the
+    # inv_update_steps window's eigh/inverse work runs as an overlapped
+    # side computation — 'sliced' (one balanced unit bucket per step,
+    # in-jit, bit-identical results one window staler) or 'host'
+    # (io_callback offload to a LAPACK worker thread, zero decomposition
+    # work in the step program; the Trainer drives the boundary swap).
+    # None keeps the synchronous boundary refresh; True selects 'sliced';
+    # or pass an async_inverse.AsyncInverseConfig. Requires a static int
+    # inv_update_steps (the window phase is compiled into the dispatch).
+    # Honored by both engines.
+    async_inverse: 'async_config_lib.AsyncInverseConfig | str | bool | None' = (
+        None
+    )
 
     def __post_init__(self) -> None:
         if self.metrics is True:
@@ -417,6 +440,37 @@ class KFACPreconditioner:
                 'some inverse updates will recompute from unchanged factors',
                 stacklevel=2,
             )
+        self.async_inverse = async_config_lib.as_async_config(
+            self.async_inverse
+        )
+        if self.async_inverse is not None and callable(self.inv_update_steps):
+            raise ValueError(
+                'async_inverse requires a static int inv_update_steps (the '
+                'refresh window phase is compiled into the step dispatch); '
+                'got a schedule'
+            )
+        self._plan_async()
+
+    def _plan_async(self) -> None:
+        """Precompute the async refresh plan (slice buckets, window size).
+
+        Attribute surface shared with the distributed engine:
+        ``_async_mode`` (None | 'sliced' | 'host'), ``_async_n_steps``
+        (window length), and for sliced mode ``_async_slices`` /
+        ``_async_n_slices`` (the balanced per-step unit buckets).
+        """
+        acfg = self.async_inverse
+        self._async_mode = None if acfg is None else acfg.mode
+        self._async_worker = None
+        self._async_apply_cache = None
+        if acfg is None:
+            return
+        self._async_n_steps = int(self.inv_update_steps)
+        if acfg.mode == 'sliced':
+            units = async_sliced.dense_units(self)
+            n = min(self._async_n_steps, acfg.max_slices or len(units))
+            self._async_slices = async_slots.plan_slices(units, n)
+            self._async_n_slices = len(self._async_slices)
 
     # ------------------------------------------------------------------ init
 
@@ -448,7 +502,7 @@ class KFACPreconditioner:
             else:
                 a_inv[name] = jnp.zeros((na, na), dtype=self.inv_dtype)
                 g_inv[name] = jnp.zeros((ng, ng), dtype=self.inv_dtype)
-        return KFACState(
+        state = KFACState(
             step=jnp.asarray(0, dtype=jnp.int32),
             a=a, g=g, qa=qa, qg=qg, da=da, dg=dg, dgda=dgda,
             a_inv=a_inv, g_inv=g_inv,
@@ -472,6 +526,13 @@ class KFACPreconditioner:
                 if self.flight is not None else None
             ),
         )
+        # host mode keeps no device-side shadow: the double buffer lives in
+        # the worker payload until the boundary apply
+        if self._async_mode == 'sliced':
+            state = state._replace(
+                shadow=async_sliced.dense_shadow(self, state)
+            )
+        return state
 
     # --------------------------------------------------------------- factors
 
@@ -844,12 +905,17 @@ class KFACPreconditioner:
                 lambda s: s,
                 state,
             )
-        state = jax.lax.cond(
-            state.step % _resolve(self.inv_update_steps, state.step) == 0,
-            self.update_inverses,
-            lambda s: s,
-            state,
-        )
+        if self._async_mode == 'sliced':
+            state = async_sliced.dense_async_step(self, state)
+        elif self._async_mode == 'host':
+            state = async_host.dense_host_step(self, state)
+        else:
+            state = jax.lax.cond(
+                state.step % _resolve(self.inv_update_steps, state.step) == 0,
+                self.update_inverses,
+                lambda s: s,
+                state,
+            )
         if self.metrics is not None and state.metrics is not None:
             scal: dict[str, jax.Array] = {}
             new_grads = self.precondition(state, grads, metrics_out=scal)
@@ -880,8 +946,20 @@ class KFACPreconditioner:
         The reference stores only factors and recomputes inverses on resume
         (kfac/base_preconditioner.py:296-308); checkpoints of
         :class:`KFACState` should save ``step``/``a``/``g`` and call this.
+
+        Under async refresh the shadow is also reset (shadow slots are
+        ephemeral): the first boundary after a mid-window restore finds an
+        incomplete shadow and skips the swap — deterministic, no torn
+        slot — and the following window refreshes normally.
         """
-        return self.update_inverses(state)
+        state = self.update_inverses(state)
+        if self._async_mode == 'sliced':
+            state = state._replace(
+                shadow=async_sliced.dense_shadow(self, state)
+            )
+        elif self._async_mode == 'host':
+            async_host.reset_worker(self)
+        return state
 
     def extract_factors(
         self, state: KFACState

@@ -25,6 +25,7 @@ import optax
 
 from kfac_tpu import health as health_lib
 from kfac_tpu import tracing
+from kfac_tpu.async_inverse import host as async_host_lib
 from kfac_tpu.layers import capture as capture_lib
 
 
@@ -283,6 +284,28 @@ class Trainer:
         if hc is not None and hc.warn:
             self.check_health(state)
 
+    def _drive_async(
+        self, state: TrainState, step: int | None
+    ) -> TrainState:
+        """Promote a completed host-offloaded inverse refresh into the
+        K-FAC state (``async_inverse`` mode ``'host'``; no-op otherwise).
+
+        With ``step``: swaps only at window boundaries, blocking until the
+        in-flight refresh lands (the swap stays boundary-atomic). Without
+        one (the scan paths, where the host cannot intervene mid-scan):
+        applies any already-completed payload non-blocking at entry.
+        """
+        if (
+            self.kfac is None
+            or state.kfac_state is None
+            or getattr(self.kfac, '_async_mode', None) != 'host'
+        ):
+            return state
+        ks = async_host_lib.pump(self.kfac, state.kfac_state, step=step)
+        if ks is state.kfac_state:
+            return state
+        return state._replace(kfac_state=ks)
+
     def _drive_checkpoints(self, state: TrainState) -> None:
         """Tick the checkpoint autopilot after a completed step.
 
@@ -343,6 +366,7 @@ class Trainer:
         device activity per training step.
         """
         self._sync_step_count(state)
+        state = self._drive_async(state, self._step_count)
         with jax.profiler.StepTraceAnnotation(
             'train', step_num=self._step_count
         ):
@@ -447,6 +471,7 @@ class Trainer:
         reference's hook-driven epoch loop with no Python in the hot path.
         Returns (final_state, per-step losses).
         """
+        state = self._drive_async(state, None)
         if not hasattr(self, '_jit_scan'):
             donate = (0,) if self.donate_state else ()
             executed = (
@@ -570,6 +595,7 @@ class Trainer:
             else None
         )
         loss = acc['loss'] / n
+        state = self._drive_async(state, self._step_count)
         new_state = self._jit_apply_kfac(
             state,
             grads_avg,
@@ -626,6 +652,7 @@ class Trainer:
                 'step_accumulate_scan requires a kfac preconditioner'
             )
         self._sync_step_count(state)
+        state = self._drive_async(state, self._step_count)
         capture_now = self._capture_now()
         if not hasattr(self, '_jit_accum_scan'):
             executed = self._executed_layers(

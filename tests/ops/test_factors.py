@@ -291,6 +291,29 @@ def test_host_eigh_matches_xla_eigh():
         )
 
 
+def test_batched_eigh_upcasts_bf16_host_under_vmap():
+    """The fp32 upcast guard: a bf16 factor stack through the 'host'
+    impl under vmap (the async host-refresh shape) decomposes in fp32 —
+    outputs are fp32, finite, and reconstruct the upcast factors."""
+    stack = jnp.stack([jnp.asarray(_random_spd(16, s)) for s in (7, 8, 9)])
+    bf16 = stack.astype(jnp.bfloat16)
+    w, v = jax.jit(
+        jax.vmap(lambda m: factors.batched_eigh(m, impl='host'))
+    )(bf16)
+    assert w.dtype == jnp.float32 and v.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(w))) and bool(jnp.all(jnp.isfinite(v)))
+    f32 = np.asarray(bf16.astype(jnp.float32))
+    for i in range(3):
+        recon = np.asarray(v[i]) @ np.diag(np.asarray(w[i])) @ np.asarray(v[i]).T
+        np.testing.assert_allclose(recon, f32[i], rtol=1e-4, atol=1e-5)
+    # the xla impl rides the same guard
+    w2, _ = factors.batched_eigh(bf16, impl='xla')
+    assert w2.dtype == jnp.float32
+    # non-real inputs are rejected outright rather than silently cast
+    with pytest.raises(TypeError, match='floating'):
+        factors.batched_eigh(jnp.eye(4, dtype=jnp.int32), impl='host')
+
+
 def test_gershgorin_condition_bound_bounds_true_condition():
     f = _random_spd(32, 23)
     damping = 0.01

@@ -1,0 +1,472 @@
+"""Async inverse refresh: double-buffered decompositions off the step path.
+
+Covers the ISSUE-6 acceptance surface: slice planning, the stale-inverse
+equivalence contract (async at cadence N bit-identical to the synchronous
+path one window earlier, for the dense engine and both KAISA transports),
+the host-offloaded backend (LAPACK basis ambiguity makes raw eigenvector
+comparison meaningless — preconditioned gradients are compared instead),
+``inv_staleness/*`` metrics truthfulness under async refresh, the
+quarantine interaction (an in-flight shadow refresh of a quarantined
+layer is discarded, not swapped), checkpoint restore mid-window
+(shadow ephemeral, rebuilt deterministically), and all four Trainer
+paths.
+
+The bit-equivalence contract requires ``factor_update_steps ==
+inv_update_steps``: slices fold in the CURRENT factors, which only match
+the synchronous boundary snapshot when factors change at boundaries
+alone. With unaligned cadences the async path sees strictly FRESHER
+mid-window factors — valid, but not bit-identical.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import kfac_tpu
+from kfac_tpu import checkpoint, enums
+from kfac_tpu import health as health_lib
+from kfac_tpu.async_inverse import (
+    AsyncInverseConfig,
+    as_async_config,
+    plan_slices,
+)
+from kfac_tpu.async_inverse import host as async_host
+from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+from testing import models
+
+WORLD = 8
+N = 4  # cadence window used throughout (factor == inverse, see docstring)
+
+_FIELDS = ('qa', 'qg', 'da', 'dg', 'dgda', 'a_inv', 'g_inv')
+
+
+def _decomps(state):
+    return jax.tree.map(np.asarray, {f: getattr(state, f) for f in _FIELDS})
+
+
+def _bit_equal(ref, got, msg):
+    eq = jax.tree.map(lambda a, b: np.array_equal(a, b), ref, got)
+    assert all(jax.tree.leaves(eq)), msg
+
+
+# ------------------------------------------------------------- configuration
+
+
+def test_async_config_normalization():
+    assert as_async_config(None) is None
+    assert as_async_config(False) is None
+    assert as_async_config(True) == AsyncInverseConfig()
+    assert as_async_config('host') == AsyncInverseConfig(mode='host')
+    cfg = AsyncInverseConfig(mode='sliced', max_slices=3)
+    assert as_async_config(cfg) is cfg
+    with pytest.raises(ValueError, match='mode'):
+        AsyncInverseConfig(mode='warp')
+    with pytest.raises(TypeError):
+        as_async_config(3.5)
+
+
+def test_async_rejects_cadence_schedule():
+    m = models.TinyModel()
+    x, _ = models.regression_data(jax.random.PRNGKey(1), n=8, dim=6)
+    reg = kfac_tpu.register_model(m, x)
+    with pytest.raises(ValueError, match='static int'):
+        kfac_tpu.KFACPreconditioner(
+            registry=reg,
+            inv_update_steps=lambda s: 4,
+            async_inverse='sliced',
+        )
+
+
+def test_plan_slices_balances_and_is_deterministic():
+    units = [
+        ('a', 8.0), ('b', 1.0), ('c', 1.0),
+        ('d', 6.0), ('e', 1.0), ('f', 1.0),
+    ]
+    s1 = plan_slices(units, 3)
+    assert s1 == plan_slices(list(units), 3)
+    assert sorted(k for sl in s1 for k in sl) == sorted(k for k, _ in units)
+    costs = dict(units)
+    loads = sorted(sum(costs[k] for k in sl) for sl in s1)
+    # LPT: the dominant unit sits alone, the small ones backfill
+    assert loads[-1] == 8.0
+    # slice count caps at the unit count; empty slices are dropped
+    assert plan_slices(units, 10) == plan_slices(units, len(units))
+    with pytest.raises(ValueError):
+        plan_slices(units, 0)
+
+
+# --------------------------------------------------- dense engine equivalence
+
+
+def _dense_pair(mode, method, health=None, prediv=False):
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=32, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    loss_fn = models.mse_loss(m)
+    kw = dict(
+        registry=reg, compute_method=method, kl_clip=None,
+        inv_update_steps=N, factor_update_steps=N, health=health,
+        prediv_eigenvalues=prediv,
+    )
+    sync = kfac_tpu.KFACPreconditioner(**kw)
+    asy = kfac_tpu.KFACPreconditioner(**kw, async_inverse=mode)
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+    return sync, asy, run, params, (x, y)
+
+
+def _run_pair(sync, asy, run, params, batch, n=12, mode='sliced'):
+    """Step both engines in lockstep on drifting params; returns per-step
+    (sync_state, async_state) pairs and the last gradients."""
+    ss, sa = sync.init(), asy.init()
+    js, ja = jax.jit(sync.step), jax.jit(asy.step)
+    hist, grads = [], None
+    for i in range(n):
+        (_, _), grads, stats = run(params, batch)
+        if mode == 'host':
+            sa = async_host.pump(asy, sa, step=i)
+        ss, _ = js(ss, grads, stats)
+        sa, _ = ja(sa, grads, stats)
+        hist.append((ss, sa))
+        params = jax.tree.map(lambda p: p * 0.999, params)
+    return hist, grads
+
+
+@pytest.mark.parametrize(
+    'method,prediv,health',
+    [
+        (enums.ComputeMethod.EIGEN, False, None),
+        (enums.ComputeMethod.EIGEN, True, None),
+        (enums.ComputeMethod.EIGEN, False, health_lib.HealthConfig(warn=False)),
+    ],
+    ids=['eigen', 'prediv', 'health'],
+)
+def test_dense_sliced_bit_identical_one_window_lag(method, prediv, health):
+    """Sliced async decompositions at step s equal the synchronous path's
+    at the previous boundary — bit-for-bit, at every swap boundary and
+    throughout the window (window 0 is the shared cold start)."""
+    sync, asy, run, params, batch = _dense_pair(
+        'sliced', method, health=health, prediv=prediv
+    )
+    hist, _ = _run_pair(sync, asy, run, params, batch)
+    for s in range(N):
+        _bit_equal(
+            _decomps(hist[s][0]), _decomps(hist[s][1]),
+            f'window-0 step {s} diverged from the shared cold start',
+        )
+    for s in range(N, len(hist)):
+        lag = (s // N) * N - N
+        _bit_equal(
+            _decomps(hist[lag][0]), _decomps(hist[s][1]),
+            f'async step {s} != sync step {lag}',
+        )
+
+
+def test_dense_sliced_inverse_matches_one_window_lag():
+    """INVERSE mode: same one-window lag, allclose rather than bit-exact
+    (the sliced warm start seeds Newton-Schulz from the ACTIVE inverse,
+    the sync path from its own previous window's)."""
+    sync, asy, run, params, batch = _dense_pair(
+        'sliced', enums.ComputeMethod.INVERSE
+    )
+    hist, _ = _run_pair(sync, asy, run, params, batch)
+    for s in range(N, len(hist)):
+        lag = (s // N) * N - N
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-3),
+            _decomps(hist[lag][0]), _decomps(hist[s][1]),
+        )
+
+
+@pytest.mark.parametrize(
+    'method', [enums.ComputeMethod.EIGEN, enums.ComputeMethod.INVERSE]
+)
+def test_dense_host_preconditions_like_lagged_sync(method):
+    """Host backend: LAPACK and XLA eigenvectors differ by sign/basis, so
+    the contract is on the preconditioner's ACTION — async preconditioned
+    gradients match the synchronous engine's one window earlier."""
+    sync, asy, run, params, batch = _dense_pair('host', method)
+    hist, grads = _run_pair(sync, asy, run, params, batch, mode='host')
+    for s in range(N, len(hist)):
+        lag = (s // N) * N - N
+        ref = jax.tree.map(np.asarray, sync.precondition(hist[lag][0], grads))
+        got = jax.tree.map(np.asarray, asy.precondition(hist[s][1], grads))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-4),
+            ref, got,
+        )
+
+
+# --------------------------------------------------------- KAISA equivalence
+
+
+def _kaisa_pair(mode, method, frac=1.0, health=None, prediv=False,
+                allreduce=enums.AllreduceMethod.ALLREDUCE):
+    mesh = kaisa_mesh(grad_worker_fraction=frac)
+    m = models.TinyModel(hidden=8, out=4)
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=WORLD * 8, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    loss_fn = models.mse_loss(m)
+    kw = dict(
+        registry=reg, compute_method=method, kl_clip=None,
+        inv_update_steps=N, factor_update_steps=N, health=health,
+        prediv_eigenvalues=prediv, allreduce_method=allreduce,
+    )
+    sync = DistributedKFAC(
+        config=kfac_tpu.KFACPreconditioner(**kw), mesh=mesh
+    )
+    asy = DistributedKFAC(
+        config=kfac_tpu.KFACPreconditioner(**kw, async_inverse=mode),
+        mesh=mesh,
+    )
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+    return sync, asy, run, params, (x, y)
+
+
+@pytest.mark.parametrize(
+    'method,frac,prediv,health,allreduce',
+    [
+        ('eigen', 1.0, False, None, enums.AllreduceMethod.ALLREDUCE),
+        ('eigen', 0.5, False, None, enums.AllreduceMethod.ALLREDUCE),
+        ('eigen', 1.0, True, None, enums.AllreduceMethod.ALLREDUCE),
+        ('inverse', 1.0, False, None, enums.AllreduceMethod.ALLREDUCE),
+        (
+            'eigen', 1.0, False, health_lib.HealthConfig(warn=False),
+            enums.AllreduceMethod.ALLREDUCE,
+        ),
+        (
+            'eigen', 1.0, False, None,
+            enums.AllreduceMethod.ALLREDUCE_BUCKETED,
+        ),
+    ],
+    ids=[
+        'eigen', 'hybrid', 'prediv', 'inverse', 'health', 'bucketed',
+    ],
+)
+def test_kaisa_sliced_bit_identical_one_window_lag(
+    method, frac, prediv, health, allreduce
+):
+    """The distributed engine's sliced backend holds the same bit-level
+    contract, across work placements and both stat transports."""
+    sync, asy, run, params, batch = _kaisa_pair(
+        'sliced', method, frac=frac, health=health, prediv=prediv,
+        allreduce=allreduce,
+    )
+    hist, _ = _run_pair(sync, asy, run, params, batch)
+    for s in range(N):
+        _bit_equal(
+            _decomps(hist[s][0]), _decomps(hist[s][1]),
+            f'window-0 step {s} diverged from the shared cold start',
+        )
+    for s in range(N, len(hist)):
+        lag = (s // N) * N - N
+        _bit_equal(
+            _decomps(hist[lag][0]), _decomps(hist[s][1]),
+            f'async step {s} != sync step {lag}',
+        )
+
+
+@pytest.mark.parametrize(
+    'method,frac,allreduce',
+    [
+        ('eigen', 1.0, enums.AllreduceMethod.ALLREDUCE),
+        ('eigen', 0.5, enums.AllreduceMethod.ALLREDUCE_BUCKETED),
+        ('inverse', 1.0, enums.AllreduceMethod.ALLREDUCE),
+    ],
+    ids=['eigen', 'hybrid_bucketed', 'inverse'],
+)
+def test_kaisa_host_preconditions_like_lagged_sync(method, frac, allreduce):
+    sync, asy, run, params, batch = _kaisa_pair(
+        'host', method, frac=frac, allreduce=allreduce
+    )
+    hist, grads = _run_pair(sync, asy, run, params, batch, mode='host')
+    for s in range(N, len(hist)):
+        lag = (s // N) * N - N
+        ref = jax.tree.map(np.asarray, sync.precondition(hist[lag][0], grads))
+        got = jax.tree.map(np.asarray, asy.precondition(hist[s][1], grads))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-4),
+            ref, got,
+        )
+
+
+# -------------------------------------------------------- staleness metrics
+
+
+def test_inv_staleness_tracks_swap_not_schedule():
+    """``last_inv_step`` advances at SWAP time: the staleness column
+    cycles through the full cadence window, never exceeding N-1."""
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=32, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    loss_fn = models.mse_loss(m)
+    asy = kfac_tpu.KFACPreconditioner(
+        registry=reg, kl_clip=None, inv_update_steps=N,
+        factor_update_steps=N, async_inverse='sliced', metrics=True,
+    )
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+    collector = kfac_tpu.MetricsCollector()
+    state = asy.init()
+    step = jax.jit(asy.step)
+    staleness = []
+    for i in range(3 * N):
+        (_, _), grads, stats = run(params, (x, y))
+        state, _ = step(state, grads, stats)
+        staleness.append(int(collector.drain(state)['inv_staleness/fc1']))
+    # cold start at 0, then a swap at every boundary
+    assert staleness == [s % N for s in range(3 * N)]
+    assert max(staleness) == N - 1
+
+
+# ---------------------------------------------------- quarantine interaction
+
+
+def test_quarantined_layer_shadow_discarded_at_swap():
+    """A layer quarantined at the boundary keeps its ACTIVE
+    decompositions — the in-flight shadow refresh is discarded, counted
+    as a bad inversion; healthy layers swap normally."""
+    from testing import faults
+
+    sync, asy, run, params, batch = _dense_pair(
+        'sliced', enums.ComputeMethod.EIGEN,
+        health=health_lib.HealthConfig(warn=False),
+    )
+    del sync
+    state = asy.init()
+    step = jax.jit(asy.step)
+    for i in range(2 * N):  # through the first swap, up to the next boundary
+        (_, _), grads, stats = run(params, batch)
+        state, _ = step(state, grads, stats)
+        params = jax.tree.map(lambda p: p * 0.999, params)
+    before = _decomps(state)
+    # the boundary step's factor update quarantines fc1 (poisoned stats);
+    # the swap in the same step must then discard fc1's finished shadow
+    (_, _), grads, stats = run(params, batch)
+    bad = faults.poison_stats(stats, 'fc1', side='a', kind='nan')
+    state, _ = step(state, grads, bad)  # boundary: swap fires
+    assert int(state.health.quarantined['fc1']) == 1
+    after = _decomps(state)
+    _bit_equal(
+        {f: before[f].get('fc1') for f in ('qa', 'qg', 'da', 'dg')},
+        {f: after[f].get('fc1') for f in ('qa', 'qg', 'da', 'dg')},
+        'quarantined layer swapped its shadow',
+    )
+    assert float(np.abs(after['qa']['fc2'] - before['qa']['fc2']).max()) > 0
+    assert int(state.health.bad_inv['fc1']) == 1
+    assert int(state.health.bad_inv['fc2']) == 0
+
+
+# ------------------------------------------------------ checkpoint round-trip
+
+
+def test_checkpoint_midwindow_restore_deterministic(tmp_path):
+    """Killing a run mid-window and restoring rebuilds the active
+    decompositions synchronously and resets the shadow: deterministic,
+    no torn slot, and the resumed run stays healthy."""
+    _, asy, run, params, batch = _dense_pair(
+        'sliced', enums.ComputeMethod.EIGEN
+    )
+    state = asy.init()
+    step = jax.jit(asy.step)
+    for i in range(N + 2):  # mid second window: shadow partially written
+        (_, _), grads, stats = run(params, batch)
+        state, _ = step(state, grads, stats)
+    assert int(state.shadow.progress) > 0
+    path = str(tmp_path / 'ck')
+    checkpoint.save(path, state, engine=asy)
+
+    r1, _ = checkpoint.restore(path, asy)
+    r2, _ = checkpoint.restore(path, asy)
+    _bit_equal(
+        jax.tree.map(np.asarray, r1),
+        jax.tree.map(np.asarray, r2),
+        'mid-window restore is not deterministic',
+    )
+    # shadow is ephemeral: rebuilt empty, progress reset
+    assert int(r1.shadow.progress) == 0
+    for f in ('qa', 'qg', 'da', 'dg'):
+        for v in getattr(r1.shadow, f).values():
+            assert float(jnp.abs(v).max()) == 0.0
+    # active slots rematerialized whole from the restored factors
+    fresh = asy.update_inverses(r1)
+    _bit_equal(
+        _decomps(fresh), _decomps(r1),
+        'restored active decompositions are torn',
+    )
+    # and the resumed run steps cleanly through the next boundary
+    for i in range(N + 1):
+        (_, _), grads, stats = run(params, batch)
+        r1, pg = step(r1, grads, stats)
+    assert all(
+        bool(jnp.all(jnp.isfinite(v))) for v in jax.tree.leaves(pg)
+    )
+
+
+# -------------------------------------------------------------- Trainer paths
+
+
+def _trainer(mode, **kw):
+    import optax
+
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=32, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+
+    def loss_fn(p, model_state, batch):
+        xx, yy = batch
+        pred = m.apply({'params': p}, xx)
+        return jnp.mean((pred - yy) ** 2), model_state
+
+    cfg = kfac_tpu.KFACPreconditioner(
+        registry=reg, kl_clip=None, inv_update_steps=N,
+        factor_update_steps=N, async_inverse=mode, **kw,
+    )
+    from kfac_tpu import training
+
+    trainer = training.Trainer(
+        loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=cfg
+    )
+    return trainer, trainer.init(params), (x, y)
+
+
+@pytest.mark.parametrize('mode', ['sliced', 'host'])
+def test_trainer_step_path(mode):
+    trainer, state, batch = _trainer(mode)
+    losses = []
+    for _ in range(2 * N + 1):  # across two swap boundaries
+        state, loss = trainer.step(state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize('mode', ['sliced', 'host'])
+def test_trainer_scan_path(mode):
+    trainer, state, (x, y) = _trainer(mode)
+    n = 2 * N + 1
+    batches = (
+        jnp.broadcast_to(x, (n,) + x.shape),
+        jnp.broadcast_to(y, (n,) + y.shape),
+    )
+    state, losses = trainer.scan_steps(state, batches)
+    assert bool(jnp.all(jnp.isfinite(losses)))
+    assert int(state.kfac_state.step) == n
+
+
+@pytest.mark.parametrize('mode', ['sliced', 'host'])
+def test_trainer_accumulate_paths(mode):
+    trainer, state, (x, y) = _trainer(mode)
+    mbs = (x.reshape(2, 16, -1), y.reshape(2, 16, -1))
+    for _ in range(N + 1):  # eager microbatch accumulation across a swap
+        trainer.accumulate_microbatch(state, (mbs[0][0], mbs[1][0]))
+        trainer.accumulate_microbatch(state, (mbs[0][1], mbs[1][1]))
+        state, loss = trainer.apply_accumulated(state)
+        assert bool(jnp.isfinite(loss))
+    for _ in range(N + 1):  # compiled accumulation loop
+        state, loss = trainer.step_accumulate_scan(state, mbs)
+        assert bool(jnp.isfinite(loss))
+    assert int(state.kfac_state.step) == 2 * (N + 1)

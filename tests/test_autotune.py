@@ -285,6 +285,84 @@ def test_trainer_auto_layout_wiring(tmp_path):
         )
 
 
+# --------------------------------------------------------- async refresh knob
+
+
+def test_predict_prices_async_refresh_spike():
+    """The model prices the refresh spike the async backends flatten:
+    sliced divides the boundary spike by the slice count, host drops the
+    device decomposition FLOPs and pays only the payload transfer."""
+    cfg, *_ = _base()
+    hw = model_lib.HardwareSpec()
+
+    def row(mode):
+        cand = model_lib.Candidate(
+            grad_worker_fraction=0.5, bucket_granularity=64,
+            inv_update_steps=4, async_inverse=mode,
+        )
+        return model_lib.predict(cand, cfg, WORLD, hw)
+
+    sync, sliced, host = row(None), row('sliced'), row('host')
+    assert sync['refresh_spike_s'] > 0
+    # sliced: same total device work, spread over the window's slices
+    assert (
+        sliced['flops_per_device_per_step']
+        == sync['flops_per_device_per_step']
+    )
+    assert sliced['refresh_spike_s'] < sync['refresh_spike_s']
+    # host: decomposition FLOPs leave the device entirely; the spike is
+    # the boundary device_put of the refreshed payload
+    assert (
+        host['flops_per_device_per_step'] < sync['flops_per_device_per_step']
+    )
+    assert host['refresh_spike_s'] == (
+        sync['bytes_per_occurrence']['decomp_reshard'] / hw.host_bandwidth
+    )
+    for r in (sync, sliced, host):
+        assert r['predicted_step_s'] > 0
+
+
+def test_async_base_widens_inverse_cadence_grid():
+    cfg, *_ = _base(
+        factor_update_steps=2, inv_update_steps=2, async_inverse='sliced'
+    )
+    cands = autotune.enumerate_candidates(WORLD, cfg)
+    # fractions x granularities x transports x {c, 2c, 4c}
+    assert len(cands) == 4 * 4 * 2 * 3
+    assert {c.inv_update_steps for c in cands} == {2, 4, 8}
+    assert all(c.async_inverse == 'sliced' for c in cands)
+    bases = autotune.baseline_candidates(WORLD, cfg)
+    assert all(b.async_inverse == 'sliced' for b in bases)
+    assert all(b in cands for b in bases)
+    # a sync base keeps the original one-cadence grid
+    sync_cfg, *_ = _base()
+    assert len(autotune.enumerate_candidates(WORLD, sync_cfg)) == 4 * 4 * 2
+
+
+def test_async_knob_rides_the_plan_roundtrip(tmp_path):
+    cfg, *_ = _base(inv_update_steps=2, async_inverse='host')
+    plan = autotune.autotune(cfg, measure=False)
+    assert plan.knobs['async_inverse'] == 'host'
+    path = tmp_path / 'plan.json'
+    plan.save(path)
+    loaded = kfac_tpu.TunedPlan.load(path)
+    new = autotune.apply_knobs(cfg, loaded.knobs)
+    assert new.async_inverse == kfac_tpu.AsyncInverseConfig(mode='host')
+
+
+def test_pre_async_plan_document_still_loads():
+    """Plans written before the async knob existed lack
+    ``knobs.async_inverse``; loading fills the sync default."""
+    cfg, *_ = _base()
+    doc = autotune.autotune(cfg, measure=False).to_json()
+    legacy = json.loads(json.dumps(doc))
+    del legacy['knobs']['async_inverse']
+    loaded = kfac_tpu.TunedPlan.from_json(legacy)
+    assert loaded.knobs['async_inverse'] is None
+    applied = autotune.apply_knobs(cfg, loaded.knobs)
+    assert applied.async_inverse is None
+
+
 def test_apply_knobs_only_touches_layout_fields():
     cfg, *_ = _base()
     plan = autotune.autotune(cfg, measure=False)

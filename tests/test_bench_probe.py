@@ -281,3 +281,15 @@ def test_resnet_stage_end_to_end_cpu(tmp_path, monkeypatch):
     assert rec['ok'] and rec['vs_baseline'] > 0
     assert rec['n_kfac_layers'] == 20
     assert rec['sgd_images_per_sec'] > 0 and rec['kfac_images_per_sec'] > 0
+
+
+@pytest.mark.slow
+def test_async_spike_probe_flattens_refresh_spike():
+    """ISSUE-6 acceptance: at d>=512 the sliced async backend holds the
+    per-step refresh spike to <= 1.5x the median step, where the
+    synchronous boundary refresh spikes multi-x."""
+    out = bench._async_spike_probe(windows=2)
+    assert out['refresh_spike_ratio'] <= 1.5, out
+    assert out['refresh_spike_ratio_sync'] > out['refresh_spike_ratio'], out
+    for k in ('step_p50_ms', 'step_p95_ms', 'step_max_ms'):
+        assert out[k] > 0 and out[f'{k}_sync'] > 0

@@ -21,8 +21,9 @@ import inspect
 import os
 import sys
 
-# (module, class, methods that must carry __kfac_scope__)
-TARGETS: list[tuple[str, str, tuple[str, ...]]] = [
+# (module, class-or-None, callables that must carry __kfac_scope__);
+# a None class means module-level functions
+TARGETS: list[tuple[str, str | None, tuple[str, ...]]] = [
     (
         'kfac_tpu.preconditioner',
         'KFACPreconditioner',
@@ -38,6 +39,16 @@ TARGETS: list[tuple[str, str, tuple[str, ...]]] = [
         'Trainer',
         ('step', 'scan_steps', 'step_accumulate', 'step_accumulate_scan'),
     ),
+    (
+        'kfac_tpu.async_inverse.sliced',
+        None,
+        ('dense_async_step', 'kaisa_async_step'),
+    ),
+    (
+        'kfac_tpu.async_inverse.host',
+        None,
+        ('dense_host_step', 'kaisa_host_step', 'pump'),
+    ),
 ]
 
 
@@ -45,14 +56,16 @@ def check() -> list[str]:
     """Return a list of 'module.Class.method' strings missing a scope."""
     missing: list[str] = []
     for mod_name, cls_name, methods in TARGETS:
-        cls = getattr(importlib.import_module(mod_name), cls_name)
+        mod = importlib.import_module(mod_name)
+        holder = mod if cls_name is None else getattr(mod, cls_name)
         for meth in methods:
             # getattr_static avoids triggering descriptors/binding; the
             # decorators stamp the underlying function object.
-            fn = inspect.getattr_static(cls, meth)
+            fn = inspect.getattr_static(holder, meth)
             fn = getattr(fn, '__func__', fn)
             if not getattr(fn, '__kfac_scope__', None):
-                missing.append(f'{mod_name}.{cls_name}.{meth}')
+                where = mod_name if cls_name is None else f'{mod_name}.{cls_name}'
+                missing.append(f'{where}.{meth}')
     return missing
 
 
