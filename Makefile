@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults async obs tune resilience inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults async obs tune resilience lint inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -45,15 +45,21 @@ async:
 	$(TEST_ENV) $(PY) tools/lint_named_scopes.py
 
 # telemetry spine: observability + flight-recorder test suites, the
-# named-scope and metric-key-schema lints, and the kfac_inspect
-# analysis selftest (see docs/OBSERVABILITY.md)
-obs: async
+# unified static-analysis pass (which includes the named-scope,
+# metric-key and plan-schema lints as KFL101-KFL103), and the
+# kfac_inspect analysis selftest (see docs/OBSERVABILITY.md)
+obs: async lint
 	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py \
 		tests/test_flight_recorder.py -q
-	$(TEST_ENV) $(PY) tools/lint_named_scopes.py
-	$(TEST_ENV) $(PY) tools/lint_metric_keys.py
-	$(TEST_ENV) $(PY) tools/lint_plan_schema.py
 	$(PY) tools/kfac_inspect.py --selftest
+
+# kfaclint: AST rules (KFL001-KFL005) + docs-vs-code drift rules
+# (KFL100-KFL104) + the analyzer's own fixture selftest and test suite
+# (see docs/ANALYSIS.md)
+lint:
+	$(TEST_ENV) $(PY) tools/kfaclint.py --all
+	$(TEST_ENV) $(PY) tools/kfaclint.py --selftest
+	$(TEST_ENV) $(PY) -m pytest tests/test_kfaclint.py -q
 
 # layout autotuner: test suite, the plan-schema doc lint, and the
 # end-to-end kfac_tune pipeline selftest (see docs/AUTOTUNE.md)

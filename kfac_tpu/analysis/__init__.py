@@ -1,0 +1,35 @@
+"""kfaclint: AST-based JAX/SPMD correctness analysis for this repo.
+
+See docs/ANALYSIS.md for the rule table and suppression syntax; the CLI
+lives at ``tools/kfaclint.py``. Importing this package populates the
+rule registry (the rule modules register on import).
+
+The AST rules (KFL001–KFL005) need only the stdlib; the drift rules
+(KFL100–KFL104) import live ``kfac_tpu`` modules at *check* time, not at
+import time, so ``from kfac_tpu import analysis`` stays cheap.
+"""
+
+from kfac_tpu.analysis import (  # noqa: F401  (imported for registration)
+    drift,
+    rules_jit,
+    rules_pytree,
+    rules_spmd,
+)
+from kfac_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Project,
+    Rule,
+    all_rules,
+    analyze,
+    get_rules,
+    load_baseline,
+    load_project,
+    register,
+    render_json,
+    render_text,
+    save_baseline,
+    split_baseline,
+)
+
+AST_RULE_CODES = ('KFL001', 'KFL002', 'KFL003', 'KFL004', 'KFL005')
+PROJECT_RULE_CODES = ('KFL100', 'KFL101', 'KFL102', 'KFL103', 'KFL104')

@@ -1,0 +1,197 @@
+"""Conservative intra-package call graph for the jit-reachability rules.
+
+The KFL001 walk needs to answer one question: *which functions can run
+inside a jitted program?* Entry points are the functions the repo marks
+with ``tracing.scope`` (the in-jit hot paths — ``tracing.trace`` marks
+host-side dispatch and is deliberately NOT an entry) or a ``jax.jit`` /
+``partial(jax.jit, ...)`` decorator. From there, edges follow
+
+- direct calls to names resolvable statically: nested functions,
+  module-level functions, ``self.method`` within the same class, and
+  ``alias.func`` through ``from``/``import`` aliases into other analyzed
+  modules;
+- function names passed as *arguments* to calls — this is what carries
+  reachability through ``jax.lax.cond(pred, launch, noop, x)`` without
+  special-casing every ``lax`` combinator.
+
+Functions handed to ``io_callback`` / ``pure_callback`` / ``debug.callback``
+run on the HOST by construction, so those argument edges are dropped —
+otherwise every host callback body would be falsely "inside jit". The
+resolver is deliberately conservative: anything it cannot resolve
+(attributes on arbitrary objects, dynamic dispatch) is simply not an
+edge, which keeps false positives down at the cost of missing exotic
+call paths.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+from kfac_tpu.analysis import core
+
+#: call targets whose function-valued arguments execute on the host
+HOST_CALLBACK_FUNCS = frozenset({
+    'io_callback', 'pure_callback', 'callback', 'debug_callback',
+})
+
+#: decorator name segments that mark an in-jit entry point
+_ENTRY_DECORATORS = frozenset({'scope', 'jit'})
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method definition in the analyzed tree."""
+
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    module: core.SourceModule
+    qualname: str  # 'f', 'Cls.m', 'f.<locals>.g'
+    cls: str | None
+    parent: 'FuncInfo | None'
+    locals_: dict[str, 'FuncInfo'] = dataclasses.field(default_factory=dict)
+
+    @property
+    def display(self) -> str:
+        return f'{self.module.modname}.{self.qualname}'
+
+
+def _decorator_is_entry(dec: ast.AST) -> bool:
+    """True for ``@scope(...)``, ``@tracing.scope(...)``, ``@jax.jit``,
+    ``@jit``, and ``@partial(jax.jit, ...)`` forms."""
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    name = core.call_name(target)
+    if name in _ENTRY_DECORATORS:
+        return True
+    if name == 'partial' and isinstance(dec, ast.Call) and dec.args:
+        return core.call_name(dec.args[0]) == 'jit'
+    return False
+
+
+class CallGraph:
+    """Function index + reachability over a :class:`core.Project`."""
+
+    def __init__(self, project: core.Project):
+        self.project = project
+        #: (module modname, qualname) -> FuncInfo
+        self.functions: dict[tuple[str, str], FuncInfo] = {}
+        #: per module: class name -> {method name -> FuncInfo}
+        self.methods: dict[str, dict[str, dict[str, FuncInfo]]] = {}
+        #: per module: alias -> dotted import target
+        self.imports: dict[str, dict[str, str]] = {}
+        for mod in project.modules:
+            self.imports[mod.modname] = core.import_map(mod.tree)
+            self.methods[mod.modname] = {}
+            self._index_body(mod, mod.tree.body, qual='', cls=None,
+                             parent=None)
+
+    # ------------------------------------------------------------- indexing
+
+    def _index_body(self, mod, body, qual, cls, parent) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qualname = f'{qual}{node.name}'
+                info = FuncInfo(node, mod, qualname, cls, parent)
+                self.functions[(mod.modname, qualname)] = info
+                if cls is not None and parent is None:
+                    self.methods[mod.modname].setdefault(cls, {})[
+                        node.name
+                    ] = info
+                if parent is not None:
+                    parent.locals_[node.name] = info
+                self._index_body(
+                    mod, node.body, qual=f'{qualname}.<locals>.',
+                    cls=cls, parent=info,
+                )
+            elif isinstance(node, ast.ClassDef):
+                self._index_body(
+                    mod, node.body, qual=f'{node.name}.',
+                    cls=node.name, parent=None,
+                )
+            elif isinstance(node, (ast.If, ast.Try, ast.With)):
+                # module-/class-level conditional defs still count
+                self._index_body(
+                    mod, [n for n in ast.iter_child_nodes(node)
+                          if isinstance(n, ast.stmt)],
+                    qual=qual, cls=cls, parent=parent,
+                )
+
+    # ------------------------------------------------------------ resolving
+
+    def entries(self) -> list[FuncInfo]:
+        return [
+            info for info in self.functions.values()
+            if any(_decorator_is_entry(d)
+                   for d in info.node.decorator_list)
+        ]
+
+    def _resolve_name(self, info: FuncInfo, name: str) -> FuncInfo | None:
+        # nested defs of the enclosing function chain win (Python scoping)
+        scope: FuncInfo | None = info
+        while scope is not None:
+            if name in scope.locals_:
+                return scope.locals_[name]
+            scope = scope.parent
+        mod = info.module.modname
+        hit = self.functions.get((mod, name))
+        if hit is not None:
+            return hit
+        target = self.imports.get(mod, {}).get(name)
+        if target and '.' in target:
+            tmod, _, attr = target.rpartition('.')
+            return self.functions.get((tmod, attr))
+        return None
+
+    def _resolve_attr(
+        self, info: FuncInfo, node: ast.Attribute
+    ) -> FuncInfo | None:
+        base = node.value
+        if isinstance(base, ast.Name):
+            if base.id == 'self' and info.cls is not None:
+                return self.methods.get(info.module.modname, {}).get(
+                    info.cls, {}
+                ).get(node.attr)
+            target = self.imports.get(info.module.modname, {}).get(base.id)
+            if target:
+                return self.functions.get((target, node.attr))
+        return None
+
+    def resolve(self, info: FuncInfo, node: ast.AST) -> FuncInfo | None:
+        if isinstance(node, ast.Name):
+            return self._resolve_name(info, node.id)
+        if isinstance(node, ast.Attribute):
+            return self._resolve_attr(info, node)
+        return None
+
+    # --------------------------------------------------------- reachability
+
+    def _edges(self, info: FuncInfo) -> Iterator[FuncInfo]:
+        for node in core.walk_skipping_functions(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.resolve(info, node.func)
+            if callee is not None:
+                yield callee
+            if core.call_name(node.func) in HOST_CALLBACK_FUNCS:
+                continue  # function args run on the host
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    hit = self.resolve(info, arg)
+                    if hit is not None:
+                        yield hit
+
+    def reachable_from_entries(self) -> dict[int, tuple[FuncInfo, str]]:
+        """{id(fn node): (FuncInfo, entry display name that reaches it)}."""
+        reached: dict[int, tuple[FuncInfo, str]] = {}
+        queue: list[tuple[FuncInfo, str]] = [
+            (e, e.display) for e in self.entries()
+        ]
+        while queue:
+            info, entry = queue.pop()
+            if id(info.node) in reached:
+                continue
+            reached[id(info.node)] = (info, entry)
+            for callee in self._edges(info):
+                if id(callee.node) not in reached:
+                    queue.append((callee, entry))
+        return reached

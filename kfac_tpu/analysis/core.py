@@ -1,0 +1,513 @@
+"""kfaclint framework core: findings, suppressions, registry, baseline.
+
+The analyzer is deliberately two-layered:
+
+- **AST rules** (``kind='ast'``) parse the target tree with ``ast`` only —
+  no imports of the analyzed code, so a rule can never be broken by an
+  import-time crash in the module it is judging, and the CLI stays usable
+  on machines without the training environment for those rules.
+- **Project rules** (``kind='project'``) are the migrated drift linters
+  (``tools/lint_*``): they import ``kfac_tpu`` and compare live objects
+  (metric schemas, signal tables, plan schemas, scope markers) against
+  the checked-in docs.
+
+Both kinds produce :class:`Finding` records that flow through one
+suppression / baseline / reporting pipeline, so ``tools/kfaclint.py
+--all`` is the single lint entry point for the repo.
+
+Suppressions are inline comments carrying a mandatory reason::
+
+    os.remove(mpath)  # kfaclint: disable=KFL002 (single writer: rank 0)
+
+A suppression without a written reason is itself reported (``KFL000``) —
+the reason is the reviewable artifact, not the silencing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Callable, Iterable, Sequence
+
+#: framework-level code for malformed / reason-less suppressions
+SUPPRESSION_CODE = 'KFL000'
+
+_SUPPRESS_RE = re.compile(
+    r'#\s*kfaclint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)'
+    r'\s*(?:\((?P<reason>[^()]*(?:\([^()]*\)[^()]*)*)\))?\s*$'
+)
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One reported defect, stable under reformatting of its message."""
+
+    path: str  # repo-root-relative (or analysis-root-relative) posix path
+    line: int
+    code: str
+    message: str
+    rule: str = ''
+    col: int = 0
+
+    def key(self) -> tuple[str, str, str]:
+        """Baseline identity: line numbers shift on unrelated edits, so a
+        baselined finding is matched by (code, path, message) only."""
+        return (self.code, self.path, self.message)
+
+    def render(self) -> str:
+        return f'{self.path}:{self.line}:{self.col}: {self.code} {self.message}'
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    lines: tuple[int, ...]  # source lines this suppression covers
+    codes: tuple[str, ...]  # rule codes, or ('all',)
+    reason: str | None
+    comment_line: int
+
+
+def _parse_suppressions(
+    text: str, lines: Sequence[str]
+) -> tuple[list[Suppression], list[tuple[int, str]]]:
+    # tokenize (rather than per-line regex) so that 'kfaclint:' inside a
+    # string or docstring — e.g. this analyzer's own source — is never
+    # mistaken for a suppression comment
+    sups: list[Suppression] = []
+    errors: list[tuple[int, str]] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return sups, errors  # the parse-error finding covers this file
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        # only directive-style comments; prose comments that merely
+        # mention the tool are not (failed) suppression attempts
+        if not re.match(r'#\s*kfaclint\b', tok.string):
+            continue
+        i = tok.start[0]
+        m = _SUPPRESS_RE.search(tok.string)
+        if m is None:
+            errors.append((
+                i,
+                "malformed kfaclint comment: expected '# kfaclint: "
+                "disable=CODE[,CODE...] (reason)'",
+            ))
+            continue
+        codes = tuple(
+            c.strip() for c in m.group(1).split(',') if c.strip()
+        )
+        reason = m.group('reason')
+        if reason is not None:
+            reason = reason.strip() or None
+        line = lines[i - 1] if i <= len(lines) else ''
+        standalone = not line[: tok.start[1]].strip()
+        covered = (i, i + 1) if standalone else (i,)
+        if reason is None:
+            errors.append((
+                i,
+                f'suppression of {",".join(codes)} has no reason: write '
+                '"# kfaclint: disable=CODE (why this finding is safe)"',
+            ))
+            continue
+        sups.append(Suppression(covered, codes, reason, i))
+    return sups, errors
+
+
+class SourceModule:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, path: str, relpath: str, modname: str, text: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, '/')
+        self.modname = modname
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.suppressions, self.suppression_errors = _parse_suppressions(
+            text, self.lines
+        )
+
+    def suppressed(self, finding: Finding) -> bool:
+        for sup in self.suppressions:
+            if finding.line not in sup.lines:
+                continue
+            if 'all' in sup.codes or finding.code in sup.codes:
+                return True
+        return False
+
+
+class Project:
+    """The set of modules one analyzer run looks at."""
+
+    def __init__(self, root: str, modules: list[SourceModule]):
+        self.root = root
+        self.modules = modules
+        self.by_modname = {m.modname: m for m in modules}
+
+    def module(self, modname: str) -> SourceModule | None:
+        return self.by_modname.get(modname)
+
+
+def _iter_py_files(target: str) -> Iterable[str]:
+    if os.path.isfile(target):
+        yield target
+        return
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if not d.startswith('.') and d != '__pycache__'
+        )
+        for name in sorted(filenames):
+            if name.endswith('.py'):
+                yield os.path.join(dirpath, name)
+
+
+def _modname_for(relpath: str) -> str:
+    parts = relpath.replace(os.sep, '/').split('/')
+    parts[-1] = parts[-1][:-3]  # strip .py
+    if parts[-1] == '__init__':
+        parts.pop()
+    return '.'.join(p for p in parts if p) or '<root>'
+
+
+def load_project(
+    root: str, targets: Sequence[str] | None = None
+) -> tuple[Project, list[Finding]]:
+    """Parse every ``.py`` under ``targets`` (default: ``root`` itself).
+
+    Unparseable files become findings instead of crashing the run — a
+    linter that dies on the file it should be reporting is useless.
+    """
+    root = os.path.abspath(root)
+    targets = [root] if not targets else [
+        t if os.path.isabs(t) else os.path.join(root, t) for t in targets
+    ]
+    modules: list[SourceModule] = []
+    errors: list[Finding] = []
+    seen: set[str] = set()
+    for target in targets:
+        for path in _iter_py_files(target):
+            path = os.path.abspath(path)
+            if path in seen:
+                continue
+            seen.add(path)
+            relpath = os.path.relpath(path, root)
+            with open(path, encoding='utf-8') as f:
+                text = f.read()
+            try:
+                modules.append(
+                    SourceModule(path, relpath, _modname_for(relpath), text)
+                )
+            except SyntaxError as exc:
+                errors.append(Finding(
+                    path=relpath.replace(os.sep, '/'),
+                    line=int(exc.lineno or 1),
+                    code=SUPPRESSION_CODE,
+                    rule='framework',
+                    message=f'file does not parse: {exc.msg}',
+                ))
+    return Project(root, modules), errors
+
+
+# ------------------------------------------------------------------ registry
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One registered check.
+
+    ``check`` receives the :class:`Project` for ``kind='ast'`` rules and
+    no arguments for ``kind='project'`` rules (the migrated drift
+    linters, which import the live code). ``what``/``why``/``how`` feed
+    the docs/ANALYSIS.md rule table and its drift guard (KFL100).
+    """
+
+    code: str
+    name: str
+    what: str
+    why: str
+    check: Callable[..., list[Finding]]
+    kind: str = 'ast'
+
+    def run(self, project: Project | None) -> list[Finding]:
+        if self.kind == 'ast':
+            assert project is not None
+            return self.check(project)
+        return self.check()
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if rule.code in _REGISTRY:
+        raise ValueError(f'duplicate rule code {rule.code}')
+    _REGISTRY[rule.code] = rule
+    return rule
+
+
+def all_rules() -> list[Rule]:
+    return [(_REGISTRY[c]) for c in sorted(_REGISTRY)]
+
+
+def get_rules(codes: Iterable[str] | None = None) -> list[Rule]:
+    if codes is None:
+        return all_rules()
+    out = []
+    for code in codes:
+        code = code.strip().upper()
+        if code not in _REGISTRY:
+            raise KeyError(
+                f'unknown rule code {code!r}; known: '
+                f'{", ".join(sorted(_REGISTRY))}'
+            )
+        out.append(_REGISTRY[code])
+    return out
+
+
+register(Rule(
+    code=SUPPRESSION_CODE,
+    name='suppression-discipline',
+    what='malformed or reason-less `# kfaclint: disable=` comments and '
+         'files that fail to parse',
+    why='a suppression without a written reason silences the next '
+        'PR-4-class bug with no reviewable justification',
+    check=lambda project: [],  # produced by the framework, not a scan
+))
+
+
+# ----------------------------------------------------------------- analysis
+
+
+def analyze(
+    project: Project,
+    rules: Sequence[Rule],
+    parse_errors: Sequence[Finding] = (),
+) -> list[Finding]:
+    """Run ``rules`` over ``project`` and apply inline suppressions.
+
+    Framework findings (parse errors, bad suppressions) are always
+    included — they cannot be turned off by rule selection, by design.
+    """
+    findings: list[Finding] = list(parse_errors)
+    for mod in project.modules:
+        for line, msg in mod.suppression_errors:
+            findings.append(Finding(
+                path=mod.relpath, line=line, code=SUPPRESSION_CODE,
+                rule='suppression-discipline', message=msg,
+            ))
+    for rule in rules:
+        if rule.code == SUPPRESSION_CODE:
+            continue
+        for f in rule.run(project):
+            findings.append(
+                dataclasses.replace(f, rule=f.rule or rule.name)
+            )
+    by_path = {m.relpath: m for m in project.modules}
+    kept = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None and f.code != SUPPRESSION_CODE and (
+            mod.suppressed(f)
+        ):
+            continue
+        kept.append(f)
+    return sorted(kept)
+
+
+# ----------------------------------------------------------------- baseline
+
+BASELINE_SCHEMA = 1
+
+
+def load_baseline(path: str) -> list[dict[str, str]]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding='utf-8') as f:
+        data = json.load(f)
+    if data.get('schema') != BASELINE_SCHEMA:
+        raise ValueError(
+            f'baseline {path!r} has schema {data.get("schema")!r}; this '
+            f'kfaclint reads schema {BASELINE_SCHEMA}'
+        )
+    return list(data.get('findings', []))
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {
+        'schema': BASELINE_SCHEMA,
+        'findings': [
+            {'code': f.code, 'path': f.path, 'message': f.message}
+            for f in sorted(findings)
+        ],
+    }
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write('\n')
+
+
+def split_baseline(
+    findings: Sequence[Finding], baseline: Sequence[dict[str, str]]
+) -> tuple[list[Finding], int]:
+    """(new findings, count matched by the baseline).
+
+    Baseline entries are consumed at most once each, so N new duplicates
+    of one baselined finding surface N-1 times.
+    """
+    pool: dict[tuple[str, str, str], int] = {}
+    for entry in baseline:
+        key = (entry['code'], entry['path'], entry['message'])
+        pool[key] = pool.get(key, 0) + 1
+    new: list[Finding] = []
+    matched = 0
+    for f in findings:
+        k = f.key()
+        if pool.get(k, 0) > 0:
+            pool[k] -= 1
+            matched += 1
+        else:
+            new.append(f)
+    return new, matched
+
+
+# ---------------------------------------------------------------- reporting
+
+REPORT_SCHEMA = 1
+
+
+def render_text(
+    findings: Sequence[Finding], baselined: int = 0, checked: int = 0
+) -> str:
+    lines = [f.render() for f in findings]
+    tail = f'kfaclint: {len(findings)} finding(s)'
+    if baselined:
+        tail += f', {baselined} baselined'
+    if checked:
+        tail += f' across {checked} file(s)'
+    lines.append(tail)
+    return '\n'.join(lines)
+
+
+def render_json(
+    findings: Sequence[Finding], baselined: int = 0, checked: int = 0
+) -> str:
+    by_code: dict[str, int] = {}
+    for f in findings:
+        by_code[f.code] = by_code.get(f.code, 0) + 1
+    return json.dumps({
+        'schema': REPORT_SCHEMA,
+        'tool': 'kfaclint',
+        'findings': [
+            {
+                'code': f.code,
+                'rule': f.rule,
+                'path': f.path,
+                'line': f.line,
+                'col': f.col,
+                'message': f.message,
+            }
+            for f in findings
+        ],
+        'summary': {
+            'total': len(findings),
+            'baselined': baselined,
+            'files_checked': checked,
+            'by_code': by_code,
+        },
+    }, indent=1, sort_keys=True)
+
+
+# ------------------------------------------------------------- AST helpers
+# shared by the rule modules; they live here so every rule resolves names
+# the same way
+
+
+def call_name(node: ast.AST) -> str | None:
+    """Last path segment of a call target: ``a.b.c(...)`` -> ``'c'``."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """Full dotted path of a Name/Attribute chain, or None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def import_map(tree: ast.Module) -> dict[str, str]:
+    """alias -> dotted target for a module's imports.
+
+    ``import numpy as np`` -> ``{'np': 'numpy'}``;
+    ``from a.b import c as d`` -> ``{'d': 'a.b.c'}``.
+    """
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split('.')[0]] = (
+                    alias.name if alias.asname else alias.name.split('.')[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and (
+            node.level == 0
+        ):
+            for alias in node.names:
+                out[alias.asname or alias.name] = (
+                    f'{node.module}.{alias.name}'
+                )
+    return out
+
+
+def walk_skipping_functions(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``node``'s subtree without descending into nested function or
+    class definitions (their bodies run in a different execution context
+    — trace time vs run time, host vs device)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                  ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def func_params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def finding_at(
+    mod: SourceModule, node: ast.AST, code: str, message: str, rule: str = ''
+) -> Finding:
+    return Finding(
+        path=mod.relpath,
+        line=getattr(node, 'lineno', 1),
+        col=getattr(node, 'col_offset', 0),
+        code=code,
+        message=message,
+        rule=rule,
+    )

@@ -1,0 +1,368 @@
+"""KFL100–KFL104: the migrated docs-vs-code drift linters.
+
+These are ``kind='project'`` rules — unlike the AST rules they import
+the live ``kfac_tpu`` modules and compare real objects (metric schemas,
+signal tables, plan schemas, scope markers) against the checked-in
+documentation. All paths resolve from the repo root derived from this
+file, so the rules work regardless of the caller's cwd; the thin
+``tools/lint_*`` wrappers keep their historical ``check()`` signatures
+on top of these functions.
+
+KFL100 is the self-referential one: it pins the rule table in
+``docs/ANALYSIS.md`` to the registry itself, so adding a rule without a
+doc row (or vice versa) fails the lint that the doc documents.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from kfac_tpu.analysis import core
+
+#: repo root: parent of the kfac_tpu package
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+ANALYSIS_DOC = 'docs/ANALYSIS.md'
+OBSERVABILITY_DOC = 'docs/OBSERVABILITY.md'
+AUTOTUNE_DOC = 'docs/AUTOTUNE.md'
+ROBUSTNESS_DOC = 'docs/ROBUSTNESS.md'
+
+#: documented metric keys that are drain-record fields, not metric_keys
+#: entries (KFL102)
+EXTRA_DOC_KEYS = frozenset({'step'})
+
+#: jitted entry points that must carry __kfac_scope__ (KFL101);
+#: (module, class-or-None, callables) — a None class means module-level
+SCOPE_TARGETS: list[tuple[str, str | None, tuple[str, ...]]] = [
+    (
+        'kfac_tpu.preconditioner',
+        'KFACPreconditioner',
+        ('step', 'update_factors', 'update_inverses', 'precondition'),
+    ),
+    (
+        'kfac_tpu.parallel.kaisa',
+        'DistributedKFAC',
+        ('step', 'update_factors', 'update_inverses', 'precondition'),
+    ),
+    (
+        'kfac_tpu.training',
+        'Trainer',
+        ('step', 'scan_steps', 'step_accumulate', 'step_accumulate_scan'),
+    ),
+    (
+        'kfac_tpu.async_inverse.sliced',
+        None,
+        ('dense_async_step', 'kaisa_async_step'),
+    ),
+    (
+        'kfac_tpu.async_inverse.host',
+        None,
+        ('dense_host_step', 'kaisa_host_step', 'pump'),
+    ),
+]
+
+
+def _abspath(doc_path: str) -> str:
+    if os.path.isabs(doc_path):
+        return doc_path
+    return os.path.join(REPO_ROOT, doc_path)
+
+
+def doc_section(
+    doc_path: str, section: str, next_heading: str = r'^#{2,3} '
+) -> tuple[str, int]:
+    """(section body, 1-based line of the heading). Raises ValueError if
+    the heading is missing — a renamed section is itself drift."""
+    with open(_abspath(doc_path), encoding='utf-8') as f:
+        text = f.read()
+    try:
+        start = text.index(section)
+    except ValueError:
+        raise ValueError(f'{doc_path} has no {section!r} section')
+    line = text[:start].count('\n') + 1
+    rest = text[start + len(section):]
+    m = re.search(next_heading, rest, re.MULTILINE)
+    return (rest[: m.start()] if m else rest), line
+
+
+def table_first_cells(section: str) -> set[str]:
+    """Backticked tokens from the first cell of each table row."""
+    keys: set[str] = set()
+    for line in section.splitlines():
+        line = line.strip()
+        if not line.startswith('| `'):
+            continue
+        keys.update(re.findall(r'`([^`]+)`', line.split('|')[1]))
+    return keys
+
+
+def _doc_findings(
+    code: str, doc_path: str, line: int, problems: list[str]
+) -> list[core.Finding]:
+    return [
+        core.Finding(path=doc_path, line=line, code=code, message=p)
+        for p in problems
+    ]
+
+
+# --------------------------------------------------------- KFL100 rule table
+
+
+def check_rule_table(doc_path: str = ANALYSIS_DOC) -> list[str]:
+    """Drift between the docs/ANALYSIS.md rule table and the registry."""
+    section, _ = doc_section(doc_path, '## Rule table')
+    documented: dict[str, str] = {}
+    for line in section.splitlines():
+        line = line.strip()
+        if not line.startswith('| `KFL'):
+            continue
+        cells = [c.strip() for c in line.split('|')]
+        m = re.match(r'`(KFL\d+)`', cells[1])
+        if m:
+            documented[m.group(1)] = cells[2].strip('` ')
+    registered = {r.code: r.name for r in core.all_rules()}
+    problems = []
+    for code in sorted(set(registered) - set(documented)):
+        problems.append(
+            f'registered rule has no row in {doc_path}: {code} '
+            f'({registered[code]})'
+        )
+    for code in sorted(set(documented) - set(registered)):
+        problems.append(f'documented rule is not registered: {code}')
+    for code in sorted(set(documented) & set(registered)):
+        if documented[code] != registered[code]:
+            problems.append(
+                f'{code}: doc table names it {documented[code]!r} but the '
+                f'registry says {registered[code]!r}'
+            )
+    return problems
+
+
+def _rule_table(**_: object) -> list[core.Finding]:
+    try:
+        _, line = doc_section(ANALYSIS_DOC, '## Rule table')
+        problems = check_rule_table()
+    except (OSError, ValueError) as exc:
+        return _doc_findings('KFL100', ANALYSIS_DOC, 1, [str(exc)])
+    return _doc_findings('KFL100', ANALYSIS_DOC, line, problems)
+
+
+# ------------------------------------------------------- KFL101 named scopes
+
+
+def _missing_scopes() -> list[tuple[str, str]]:
+    """(module name, 'module[.Class].method') per unannotated entry."""
+    import importlib
+    import inspect
+
+    missing: list[tuple[str, str]] = []
+    for mod_name, cls_name, methods in SCOPE_TARGETS:
+        mod = importlib.import_module(mod_name)
+        holder = mod if cls_name is None else getattr(mod, cls_name)
+        for meth in methods:
+            # getattr_static avoids triggering descriptors/binding; the
+            # decorators stamp the underlying function object.
+            fn = inspect.getattr_static(holder, meth)
+            fn = getattr(fn, '__func__', fn)
+            if not getattr(fn, '__kfac_scope__', None):
+                where = (
+                    mod_name if cls_name is None
+                    else f'{mod_name}.{cls_name}'
+                )
+                missing.append((mod_name, f'{where}.{meth}'))
+    return missing
+
+
+def check_named_scopes() -> list[str]:
+    """'module.Class.method' for every entry point missing a scope."""
+    return [name for _, name in _missing_scopes()]
+
+
+def _named_scopes() -> list[core.Finding]:
+    return [
+        core.Finding(
+            path=mod_name.replace('.', '/') + '.py',
+            line=1, code='KFL101',
+            message=f'jitted entry point missing tracing.trace/scope '
+                    f'annotation: {name}',
+        )
+        for mod_name, name in _missing_scopes()
+    ]
+
+
+# -------------------------------------------------------- KFL102 metric keys
+
+
+def check_metric_keys(doc_path: str = OBSERVABILITY_DOC) -> list[str]:
+    section, _ = doc_section(doc_path, '### Metric-key schema')
+    documented = table_first_cells(section)
+    from kfac_tpu import health
+    from kfac_tpu.observability import metrics as metrics_lib
+
+    names = ['<layer>']
+    actual = set(metrics_lib.metric_keys(metrics_lib.MetricsConfig(), names))
+    actual |= set(health.health_metric_keys(names))
+    actual |= EXTRA_DOC_KEYS
+    problems = []
+    for k in sorted(actual - documented):
+        problems.append(f'undocumented key (add to {doc_path}): {k}')
+    for k in sorted(documented - actual):
+        problems.append(f'documented key not produced by the code: {k}')
+    return problems
+
+
+def _metric_keys() -> list[core.Finding]:
+    _, line = doc_section(OBSERVABILITY_DOC, '### Metric-key schema')
+    return _doc_findings(
+        'KFL102', OBSERVABILITY_DOC, line, check_metric_keys()
+    )
+
+
+# -------------------------------------------------------- KFL103 plan schema
+
+
+def check_plan_schema(doc_path: str = AUTOTUNE_DOC) -> list[str]:
+    section, _ = doc_section(doc_path, '### Plan schema')
+    documented = table_first_cells(section)
+    from kfac_tpu.autotune import plan as plan_lib
+
+    produced = set(plan_lib.plan_schema_keys())
+    problems = []
+    for k in sorted(produced - documented):
+        problems.append(f'undocumented plan field (add to {doc_path}): {k}')
+    for k in sorted(documented - produced):
+        problems.append(f'documented field not in the plan schema: {k}')
+    return problems
+
+
+def _plan_schema() -> list[core.Finding]:
+    _, line = doc_section(AUTOTUNE_DOC, '### Plan schema')
+    return _doc_findings('KFL103', AUTOTUNE_DOC, line, check_plan_schema())
+
+
+# ------------------------------------------------------------ KFL104 signals
+
+
+def doc_signals(doc_path: str = ROBUSTNESS_DOC) -> dict[str, bool]:
+    """{signal name: exits} parsed from the section's table rows."""
+    section, _ = doc_section(
+        doc_path, '## Signal semantics', next_heading=r'^#{1,3} '
+    )
+    out: dict[str, bool] = {}
+    for line in section.splitlines():
+        line = line.strip()
+        if not line.startswith('| `'):
+            continue
+        cells = line.split('|')
+        names = re.findall(r'`(SIG[A-Z0-9]+)`', cells[1])
+        if not names:
+            continue
+        semantics = cells[2].lower()
+        exits = 'exit' in semantics
+        if not exits and 'continue' not in semantics:
+            raise ValueError(
+                f'{doc_path}: signal-table row for {names} states '
+                f'neither "exit" nor "continue": {cells[2].strip()!r}'
+            )
+        for name in names:
+            out[name] = exits
+    return out
+
+
+def check_signals(doc_path: str = ROBUSTNESS_DOC) -> list[str]:
+    documented = doc_signals(doc_path)
+    from kfac_tpu.resilience import signals
+
+    actual = {
+        name: spec.exits for name, spec in signals.HANDLED_SIGNALS.items()
+    }
+    problems = []
+    for name in sorted(set(actual) - set(documented)):
+        problems.append(
+            f'handled signal not documented (add to {doc_path}): {name}'
+        )
+    for name in sorted(set(documented) - set(actual)):
+        problems.append(
+            f'documented signal has no handler in signals.py: {name}'
+        )
+    for name in sorted(set(actual) & set(documented)):
+        if actual[name] != documented[name]:
+            problems.append(
+                f'{name}: docs say '
+                f'{"exit" if documented[name] else "continue"} but '
+                f'HANDLED_SIGNALS.exits={actual[name]}'
+            )
+    return problems
+
+
+def _signals() -> list[core.Finding]:
+    _, line = doc_section(
+        ROBUSTNESS_DOC, '## Signal semantics', next_heading=r'^#{1,3} '
+    )
+    return _doc_findings('KFL104', ROBUSTNESS_DOC, line, check_signals())
+
+
+# --------------------------------------------------------------- registration
+
+
+core.register(core.Rule(
+    code='KFL100',
+    name='doc-rule-table',
+    what='drift between the docs/ANALYSIS.md rule table and the live '
+         'rule registry (missing rows, stale rows, renamed rules)',
+    why='a rule that is not in the table is invisible to the people it '
+        'is supposed to teach; this is the same doc-vs-code contract the '
+        'repo already enforces for metrics, plans and signals',
+    check=_rule_table,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL101',
+    name='named-scopes',
+    what='jitted engine entry points (step/update_factors/'
+         'update_inverses/precondition/async pumps) missing the '
+         '`__kfac_scope__` stamp from tracing.trace/tracing.scope',
+    why='XLA profiler attribution of device time to K-FAC phases '
+        '(docs/OBSERVABILITY.md) dies silently when a refactor drops a '
+        'named scope',
+    check=_named_scopes,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL102',
+    name='metric-keys-doc',
+    what='drift between the docs/OBSERVABILITY.md metric-key tables and '
+         '`metric_keys()` + `health_metric_keys()`',
+    why='dashboards and kfac_inspect key off the drained-record schema; '
+        'an undocumented key is an unmonitorable one',
+    check=_metric_keys,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL103',
+    name='plan-schema-doc',
+    what='drift between the docs/AUTOTUNE.md plan-schema table and '
+         '`plan_schema_keys()`',
+    why='tuned plans are persisted JSON read across sessions; schema '
+        'drift bricks saved plans without an error message',
+    check=_plan_schema,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL104',
+    name='signal-semantics-doc',
+    what='drift between the docs/ROBUSTNESS.md signal table and '
+         '`resilience.signals.HANDLED_SIGNALS` (including exit-vs-'
+         'continue semantics)',
+    why='cluster launch scripts send SIGTERM/SIGUSR1 expecting exactly '
+        'the documented behavior; a flipped exits flag strands jobs',
+    check=_signals,
+    kind='project',
+))

@@ -1,0 +1,297 @@
+"""KFL001 host-sync-in-jit and KFL004 recompile-hazard rules.
+
+Both rules reason about code that runs under ``jax.jit``. The repo marks
+its in-jit hot paths with ``tracing.scope(...)`` (which stamps
+``__kfac_scope__`` and opens a ``jax.named_scope``), so "inside jit" is a
+statically answerable question: a function is in-jit if a scope/jit entry
+point reaches it through the :mod:`kfac_tpu.analysis.callgraph` walk.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kfac_tpu.analysis import callgraph, core
+
+#: numpy-ish aliases whose materializing calls block on device transfer
+_NUMPY_MODULES = frozenset({'numpy'})
+_MATERIALIZE_ATTRS = frozenset({'asarray', 'array', 'asanyarray'})
+_DEVICE_GET = frozenset({'device_get', 'block_until_ready'})
+
+#: parameter root names that are config/plumbing, not traced arrays.
+#: ``float(cfg.damping)`` at trace time is fine; ``float(grads)`` is not.
+_STATIC_PARAM_NAMES = frozenset({
+    'self', 'cls', 'config', 'cfg', 'engine', 'opts', 'options',
+    'settings', 'spec', 'plan', 'mesh', 'names', 'name', 'shapes',
+})
+
+
+def _root_name(node: ast.AST) -> str | None:
+    """Base Name of an attribute/subscript chain: ``a.b[0].c`` -> ``'a'``."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _involves_traced_param(node: ast.AST, params: set[str]) -> bool:
+    """Does ``node`` mention a parameter that is plausibly a traced array?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in params:
+            return True
+    return False
+
+
+def _traced_params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    return {
+        p for p in core.func_params(fn) if p not in _STATIC_PARAM_NAMES
+    }
+
+
+def check_host_sync(project: core.Project) -> list[core.Finding]:
+    """KFL001: host synchronization reachable from a jitted entry point.
+
+    ``.item()``, ``jax.device_get`` / ``.block_until_ready()``,
+    ``np.asarray``/``np.array`` on anything, and ``float()/int()/bool()``
+    applied to expressions involving (non-config) parameters — all of
+    these force a device→host transfer, which inside jit is either a
+    tracer error at runtime or, worse, a silent per-step sync when the
+    function is also called eagerly.
+    """
+    findings: list[core.Finding] = []
+    graph = callgraph.CallGraph(project)
+    for info, entry in graph.reachable_from_entries().values():
+        mod = info.module
+        imports = graph.imports.get(mod.modname, {})
+        traced = _traced_params(info.node)
+        via = '' if info.display == entry else f' (reached from {entry})'
+        for node in core.walk_skipping_functions(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = core.call_name(node.func)
+            if name == 'item' and isinstance(node.func, ast.Attribute):
+                findings.append(core.finding_at(
+                    mod, node, 'KFL001',
+                    f'.item() in jitted {info.qualname}{via}: forces a '
+                    'device->host sync; return the array and resolve it '
+                    'on the host side',
+                ))
+            elif name in _DEVICE_GET and isinstance(
+                node.func, ast.Attribute
+            ):
+                base = _root_name(node.func.value)
+                if base is None or imports.get(base) == 'jax' or (
+                    name == 'block_until_ready'
+                ):
+                    findings.append(core.finding_at(
+                        mod, node, 'KFL001',
+                        f'{name}() in jitted {info.qualname}{via}: host '
+                        'transfer inside a traced function',
+                    ))
+            elif name in _MATERIALIZE_ATTRS and isinstance(
+                node.func, ast.Attribute
+            ):
+                base = _root_name(node.func.value)
+                if base is not None and imports.get(base) in _NUMPY_MODULES:
+                    findings.append(core.finding_at(
+                        mod, node, 'KFL001',
+                        f'np.{name}() in jitted {info.qualname}{via}: '
+                        'materializes the operand on the host; use '
+                        'jnp equivalents inside jit',
+                    ))
+            elif name in ('float', 'int', 'bool') and isinstance(
+                node.func, ast.Name
+            ):
+                if node.args and _involves_traced_param(
+                    node.args[0], traced
+                ):
+                    findings.append(core.finding_at(
+                        mod, node, 'KFL001',
+                        f'{name}() on a traced value in jitted '
+                        f'{info.qualname}{via}: concretizes a tracer '
+                        '(ConcretizationTypeError under jit, silent sync '
+                        'eagerly)',
+                    ))
+    return findings
+
+
+# ----------------------------------------------------------------- KFL004
+
+
+_UNHASHABLE_LITERALS = (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                        ast.ListComp, ast.SetComp)
+_STATIC_KWARGS = frozenset({'static_argnums', 'static_argnames'})
+_UNHASHABLE_ANNOTATIONS = frozenset({'dict', 'Dict', 'list', 'List',
+                                     'set', 'Set'})
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = core.call_name(node.func)
+    if name == 'jit':
+        return True
+    if name == 'partial' and node.args:
+        return core.call_name(node.args[0]) == 'jit'
+    return False
+
+
+def _static_names_of(node: ast.Call) -> set[str]:
+    """Statically-known names from a ``static_argnames=`` kwarg."""
+    out: set[str] = set()
+    for kw in node.keywords:
+        if kw.arg != 'static_argnames':
+            continue
+        vals = (
+            kw.value.elts
+            if isinstance(kw.value, (ast.Tuple, ast.List))
+            else [kw.value]
+        )
+        for v in vals:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+    return out
+
+
+def _jit_static_param_names(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> set[str] | None:
+    """Static arg names if ``fn`` is jit/scope-decorated, else None."""
+    static: set[str] = set()
+    decorated = False
+    for dec in fn.decorator_list:
+        if callgraph._decorator_is_entry(dec):
+            decorated = True
+            if isinstance(dec, ast.Call):
+                static |= _static_names_of(dec)
+                for kw in dec.keywords:
+                    if kw.arg == 'static_argnums':
+                        nums = (
+                            kw.value.elts
+                            if isinstance(kw.value, (ast.Tuple, ast.List))
+                            else [kw.value]
+                        )
+                        params = core.func_params(fn)
+                        for v in nums:
+                            if isinstance(v, ast.Constant) and isinstance(
+                                v.value, int
+                            ) and 0 <= v.value < len(params):
+                                static.add(params[v.value])
+    return static if decorated else None
+
+
+def _ann_is_unhashable(ann: ast.AST | None) -> bool:
+    if ann is None:
+        return False
+    base = ann.value if isinstance(ann, ast.Subscript) else ann
+    name = core.call_name(base)
+    return name in _UNHASHABLE_ANNOTATIONS
+
+
+def check_recompile_hazard(project: core.Project) -> list[core.Finding]:
+    """KFL004: jit arguments that defeat the compilation cache, and
+    Python truthiness on tracers.
+
+    - a dict/list/set literal passed where jit hashes it (``static_*``
+      kwargs, or positionally at a static position) recompiles every
+      call — or raises ``Unhashable static arguments``;
+    - a parameter annotated/defaulted as a dict marked static has the
+      same problem, one layer removed;
+    - ``if x:`` / ``while x:`` on a bare non-static parameter of a
+      scope/jit-decorated function is a trace-time
+      ConcretizationTypeError waiting for the first non-concrete call.
+    """
+    findings: list[core.Finding] = []
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_jit_call(node):
+                for kw in node.keywords:
+                    # lists/sets of indices/names are legal here; a dict
+                    # is always a misuse (and unhashable to boot)
+                    if kw.arg in _STATIC_KWARGS and isinstance(
+                        kw.value, (ast.Dict, ast.DictComp)
+                    ):
+                        findings.append(core.finding_at(
+                            mod, kw.value, 'KFL004',
+                            f'{kw.arg}= given a dict literal: it takes '
+                            'indices/names, and jit static values must '
+                            'be hashable',
+                        ))
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            static = _jit_static_param_names(node)
+            if static is None:
+                continue
+            # (a) static params whose annotation/default is unhashable
+            args = node.args
+            all_params = args.posonlyargs + args.args + args.kwonlyargs
+            defaults: dict[str, ast.AST] = {}
+            pos = args.posonlyargs + args.args
+            for p, d in zip(pos[len(pos) - len(args.defaults):],
+                            args.defaults):
+                defaults[p.arg] = d
+            for p, d in zip(args.kwonlyargs, args.kw_defaults):
+                if d is not None:
+                    defaults[p.arg] = d
+            for p in all_params:
+                if p.arg not in static:
+                    continue
+                if _ann_is_unhashable(p.annotation) or isinstance(
+                    defaults.get(p.arg), _UNHASHABLE_LITERALS
+                ):
+                    findings.append(core.finding_at(
+                        mod, p, 'KFL004',
+                        f'static arg {p.arg!r} of {node.name} is '
+                        'dict/list/set-typed: unhashable static jit args '
+                        'raise at dispatch (wrap in a frozen/hashable '
+                        'config instead)',
+                    ))
+            # (b) truthiness branches on (likely) tracer params
+            branch_params = _traced_params(node) - static
+            for sub in core.walk_skipping_functions(node):
+                test = None
+                if isinstance(sub, (ast.If, ast.While)):
+                    test = sub.test
+                elif isinstance(sub, ast.IfExp):
+                    test = sub.test
+                if (
+                    isinstance(test, ast.Name)
+                    and test.id in branch_params
+                ):
+                    findings.append(core.finding_at(
+                        mod, test, 'KFL004',
+                        f'Python truthiness on parameter {test.id!r} '
+                        f'inside jitted {node.name}: branches on a '
+                        'tracer recompile per value or raise '
+                        'ConcretizationTypeError; use lax.cond / '
+                        'jnp.where, or mark the arg static',
+                    ))
+    return findings
+
+
+core.register(core.Rule(
+    code='KFL001',
+    name='host-sync-in-jit',
+    what='`.item()`, `float()/int()/bool()` on traced values, '
+         '`np.asarray`/`jax.device_get` reachable from a '
+         '`tracing.scope`/`jax.jit` entry point',
+    why='the PR-6 async refresh moved inversion off the step critical '
+        'path precisely because one hidden host sync stalls the whole '
+        'TPU pipeline; this rule keeps new ones out of the jitted hot '
+        'paths',
+    check=check_host_sync,
+))
+
+core.register(core.Rule(
+    code='KFL004',
+    name='recompile-hazard',
+    what='unhashable/dict-typed `static_argnums`/`static_argnames` and '
+         'Python truthiness branching on tracer parameters in scoped '
+         'functions',
+    why='a recompile per step silently erases the layout-autotuner wins '
+        '(PR 5 measured compile costs dominating small-step regimes); '
+        'unhashable statics fail only at dispatch time, far from the '
+        'definition',
+    check=check_recompile_hazard,
+))

@@ -1,0 +1,273 @@
+"""KFL003 ephemeral-pytree drift.
+
+The engine states carry trailing *ephemeral* fields (``health``,
+``metrics``, ``flight``, ``shadow`` — all defaulted ``None``): device
+telemetry that is rebuilt by ``init()`` on restore and must therefore
+(1) never leak into the checkpoint manifest, (2) still appear in
+``state_shardings`` (an under-specified sharding tree silently
+replicates the buffer), and (3) round-trip through any hand-written
+``tree_flatten``/``tree_unflatten`` pair in the same field order. Each
+of the three sub-checks below guards one of those edges; all are
+skipped when the code is not statically provable (dict-keyed pytrees
+like ``CapturedStats``), never guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kfac_tpu.analysis import core
+
+
+def _class_functions(cls: ast.ClassDef) -> dict[str, ast.FunctionDef]:
+    return {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _self_attr_names(node: ast.AST) -> list[str] | None:
+    """``(self.a, self.b)`` -> ['a', 'b']; None if any element is not a
+    plain ``self.X`` (computed flatten — not statically checkable)."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out: list[str] = []
+    for elt in node.elts:
+        if (
+            isinstance(elt, ast.Attribute)
+            and isinstance(elt.value, ast.Name)
+            and elt.value.id == 'self'
+        ):
+            out.append(elt.attr)
+        else:
+            return None
+    return out
+
+
+def _flatten_parts(
+    fn: ast.FunctionDef,
+) -> tuple[list[str], list[str]] | None:
+    """(children attrs, aux attrs) from a canonical ``tree_flatten`` that
+    returns a literal ``(children_tuple, aux_tuple)`` of ``self.X``."""
+    for stmt in fn.body:
+        if not isinstance(stmt, ast.Return) or stmt.value is None:
+            continue
+        ret = stmt.value
+        if isinstance(ret, ast.Tuple) and len(ret.elts) == 2:
+            children = _self_attr_names(ret.elts[0])
+            aux = _self_attr_names(ret.elts[1])
+            if children is not None and aux is not None:
+                return children, aux
+    return None
+
+
+def _unflatten_shape(fn: ast.FunctionDef) -> tuple[int, bool] | None:
+    """For a ``tree_unflatten`` ending in ``return cls(a, b, *children)``:
+    (number of leading explicit args, has-starred-children). None when
+    the constructor call is not that shape (e.g. dict reassembly)."""
+    for stmt in fn.body:
+        if not isinstance(stmt, ast.Return) or not isinstance(
+            stmt.value, ast.Call
+        ):
+            continue
+        call = stmt.value
+        if core.call_name(call.func) != 'cls' or call.keywords:
+            return None
+        leading = 0
+        starred = False
+        for arg in call.args:
+            if isinstance(arg, ast.Starred):
+                starred = True
+            elif starred:
+                return None  # args after *children — bail out
+            else:
+                leading += 1
+        return leading, starred
+    return None
+
+
+def _check_registered_pytrees(project: core.Project) -> list[core.Finding]:
+    """(sub-check 3) flatten/unflatten field-order consistency."""
+    findings: list[core.Finding] = []
+    for mod in project.modules:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not any(
+                core.call_name(d) == 'register_pytree_node_class'
+                for d in cls.decorator_list
+            ):
+                continue
+            fns = _class_functions(cls)
+            flat = fns.get('tree_flatten')
+            unflat = fns.get('tree_unflatten')
+            init = fns.get('__init__')
+            if flat is None or unflat is None:
+                findings.append(core.finding_at(
+                    mod, cls, 'KFL003',
+                    f'{cls.name} registered via '
+                    'register_pytree_node_class but missing '
+                    'tree_flatten/tree_unflatten',
+                ))
+                continue
+            parts = _flatten_parts(flat)
+            shape = _unflatten_shape(unflat)
+            if parts is None or shape is None or init is None:
+                continue  # non-canonical (dict-keyed etc.) — not provable
+            children, aux = parts
+            leading, starred = shape
+            init_params = core.func_params(init)[1:]  # drop self
+            if leading != len(aux):
+                findings.append(core.finding_at(
+                    mod, unflat, 'KFL003',
+                    f'{cls.name}.tree_unflatten passes {leading} leading '
+                    f'arg(s) to cls() but tree_flatten stores '
+                    f'{len(aux)} aux field(s) ({", ".join(aux)})',
+                ))
+                continue
+            expected = init_params[:leading] + (
+                init_params[leading:leading + len(children)]
+                if starred else []
+            )
+            actual = aux + (children if starred else [])
+            if expected != actual:
+                findings.append(core.finding_at(
+                    mod, flat, 'KFL003',
+                    f'{cls.name} flatten/unflatten field order '
+                    f'({", ".join(actual)}) does not match __init__ '
+                    f'({", ".join(expected)}): unflatten will scramble '
+                    'fields after a jit round-trip',
+                ))
+    return findings
+
+
+# ------------------------------------------------- NamedTuple state classes
+
+
+def _named_tuple_states(
+    project: core.Project,
+) -> dict[str, tuple[core.SourceModule, ast.ClassDef, list[str], list[str]]]:
+    """name -> (module, classdef, all fields, ephemeral fields) for every
+    ``class XState(NamedTuple)`` with trailing ``= None`` fields."""
+    out = {}
+    for mod in project.modules:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not any(
+                core.call_name(b) == 'NamedTuple' for b in cls.bases
+            ):
+                continue
+            fields: list[str] = []
+            ephemeral: list[str] = []
+            for stmt in cls.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    fields.append(stmt.target.id)
+                    if isinstance(stmt.value, ast.Constant) and (
+                        stmt.value.value is None
+                    ):
+                        ephemeral.append(stmt.target.id)
+            if ephemeral:
+                out[cls.name] = (mod, cls, fields, ephemeral)
+    return out
+
+
+def _check_durable_state(
+    project: core.Project, states: dict
+) -> list[core.Finding]:
+    """(sub-check 1) ``durable_state`` must not read ephemeral fields
+    directly — ``state.metrics`` would put rebuilt-on-restore device
+    telemetry into the checkpoint manifest (and crash on engines that
+    run with it disabled, where the field is None). ``getattr(state,
+    'health', None)``-style guarded access is the sanctioned form and is
+    naturally not an ``ast.Attribute``."""
+    ephemeral_all = {
+        f for (_, _, _, eph) in states.values() for f in eph
+    }
+    findings: list[core.Finding] = []
+    for mod in project.modules:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name != 'durable_state':
+                continue
+            params = set(core.func_params(fn))
+            for node in core.walk_skipping_functions(fn):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in params
+                    and node.attr in ephemeral_all
+                ):
+                    findings.append(core.finding_at(
+                        mod, node, 'KFL003',
+                        f'durable_state reads ephemeral field '
+                        f'{node.attr!r} directly: ephemeral state is '
+                        'rebuilt by init() and must stay out of the '
+                        'checkpoint manifest (guard with getattr(..., '
+                        'None) if conditionally persisted)',
+                    ))
+    return findings
+
+
+def _check_state_shardings(
+    project: core.Project, states: dict
+) -> list[core.Finding]:
+    """(sub-check 2) every keyword construction of a *State NamedTuple
+    inside a ``state_shardings`` function must name every field — a
+    missing ephemeral field means its device buffer gets no sharding and
+    silently replicates across the mesh."""
+    findings: list[core.Finding] = []
+    for mod in project.modules:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if 'state_shardings' not in fn.name:
+                continue
+            for node in core.walk_skipping_functions(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = core.call_name(node.func)
+                if name not in states:
+                    continue
+                if node.args or any(
+                    kw.arg is None for kw in node.keywords
+                ):
+                    continue  # positional / **kwargs — not provable
+                given = {kw.arg for kw in node.keywords}
+                _, _, fields, _ = states[name]
+                missing = [f for f in fields if f not in given]
+                if missing:
+                    findings.append(core.finding_at(
+                        mod, node, 'KFL003',
+                        f'{name} built in {fn.name} without field(s) '
+                        f'{", ".join(missing)}: unsharded state buffers '
+                        'replicate across the mesh',
+                    ))
+    return findings
+
+
+def check_ephemeral_pytree(project: core.Project) -> list[core.Finding]:
+    states = _named_tuple_states(project)
+    return (
+        _check_registered_pytrees(project)
+        + _check_durable_state(project, states)
+        + _check_state_shardings(project, states)
+    )
+
+
+core.register(core.Rule(
+    code='KFL003',
+    name='ephemeral-pytree-drift',
+    what='registered pytrees with inconsistent flatten/unflatten field '
+         'order; ephemeral (None-defaulted) state fields read by '
+         '`durable_state` or missing from `state_shardings`',
+    why='the ephemeral tail (health/metrics/flight/shadow) grew one '
+        'field per PR; each addition had to be threaded through '
+        'checkpoint manifest exclusion and the sharding tree by hand, '
+        'and a miss is silent until a restore or a replicated buffer '
+        'blows memory',
+    check=check_ephemeral_pytree,
+))

@@ -1,0 +1,220 @@
+"""KFL002 rank-divergent I/O and KFL005 callback-discipline rules.
+
+These target the multi-host failure class from the PR-4 review: rank 0
+mutating shared filesystem state while peers race past it, and host
+callbacks whose ordering semantics were left implicit. Both scans are
+intraprocedural over each function body — conservative, but exactly
+scoped to the patterns that have actually bitten this repo.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kfac_tpu.analysis import core
+
+#: file-mutating calls by module attribute (``os.replace(...)``)
+_MUTATING_ATTRS: dict[str, frozenset[str]] = {
+    'os': frozenset({
+        'remove', 'replace', 'rename', 'unlink', 'rmdir', 'makedirs',
+        'mkdir', 'removedirs', 'symlink', 'link', 'truncate',
+    }),
+    'shutil': frozenset({'rmtree', 'move', 'copy', 'copy2', 'copytree',
+                         'copyfile'}),
+}
+
+#: calls that establish a cross-host ordering edge
+_ORDERING_CALLS = frozenset({
+    'barrier', 'agree_emergency', 'sync_global_devices',
+    'assert_same_step',
+})
+
+_RANK_FUNCS = frozenset({'process_index'})
+
+
+def _is_rank_test(node: ast.AST) -> bool:
+    """``process_index() == 0`` / ``!= 0`` / bare call in a Compare."""
+    if isinstance(node, ast.Compare):
+        operands = [node.left] + list(node.comparators)
+        return any(
+            isinstance(op, ast.Call)
+            and core.call_name(op.func) in _RANK_FUNCS
+            for op in operands
+        )
+    if isinstance(node, ast.BoolOp):
+        return any(_is_rank_test(v) for v in node.values)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return _is_rank_test(node.operand)
+    return False
+
+
+def _body_only_exits(body: list[ast.stmt]) -> bool:
+    return all(isinstance(s, (ast.Return, ast.Raise, ast.Continue,
+                              ast.Pass)) for s in body) and any(
+        isinstance(s, (ast.Return, ast.Raise, ast.Continue))
+        for s in body
+    )
+
+
+def _mutation_calls(stmts: list[ast.stmt]) -> list[tuple[ast.Call, str]]:
+    """(call node, description) for every file mutation in ``stmts``,
+    including inside nested control flow but not nested functions."""
+    out: list[tuple[ast.Call, str]] = []
+    for stmt in stmts:
+        for node in [stmt, *core.walk_skipping_functions(stmt)]:
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name
+            ):
+                base, attr = func.value.id, func.attr
+                if attr in _MUTATING_ATTRS.get(base, frozenset()):
+                    out.append((node, f'{base}.{attr}()'))
+                    continue
+            if isinstance(func, ast.Name) and func.id == 'open':
+                for i, arg in enumerate(node.args):
+                    if i == 1 and isinstance(arg, ast.Constant) and (
+                        isinstance(arg.value, str)
+                        and any(c in arg.value for c in 'wax+')
+                    ):
+                        out.append((node, "open(..., 'w')"))
+                for kw in node.keywords:
+                    if kw.arg == 'mode' and isinstance(
+                        kw.value, ast.Constant
+                    ) and isinstance(kw.value.value, str) and any(
+                        c in kw.value.value for c in 'wax+'
+                    ):
+                        out.append((node, "open(..., 'w')"))
+    return out
+
+
+def _has_ordering_edge(fn: ast.AST) -> bool:
+    for node in core.walk_skipping_functions(fn):
+        if isinstance(node, ast.Call) and (
+            core.call_name(node.func) in _ORDERING_CALLS
+        ):
+            return True
+    return False
+
+
+def check_rank_divergent_io(project: core.Project) -> list[core.Finding]:
+    """KFL002: rank-0-guarded filesystem mutation with no ordering edge.
+
+    Two guard shapes are recognized:
+
+    - form A: ``if process_index() == 0: <mutations>`` — the mutations
+      inside the branch (or its ``else``) are rank-divergent;
+    - form B: ``if process_index() != 0: return`` — everything after the
+      early return runs on rank 0 only.
+
+    Either is fine *if* the same function also takes a
+    ``multihost.barrier`` / ``agree_emergency`` /
+    ``sync_global_devices`` / ``assert_same_step`` edge, which is what
+    orders the mutation against the peers. Without one, a peer can race
+    past the write (the PR-4 emergency-checkpoint rotation bug).
+    """
+    findings: list[core.Finding] = []
+    for mod in project.modules:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if _has_ordering_edge(fn):
+                continue
+            divergent: list[tuple[ast.Call, str]] = []
+            for node in core.walk_skipping_functions(fn):
+                if not isinstance(node, ast.If) or not _is_rank_test(
+                    node.test
+                ):
+                    continue
+                if _body_only_exits(node.body):
+                    # form B: the guard peels non-writers off; scan the
+                    # whole remaining function body
+                    divergent.extend(_mutation_calls(fn.body))
+                else:
+                    divergent.extend(_mutation_calls(node.body))
+                    divergent.extend(_mutation_calls(node.orelse))
+            seen: set[int] = set()
+            for call, desc in divergent:
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                findings.append(core.finding_at(
+                    mod, call, 'KFL002',
+                    f'{desc} under a process_index() guard in {fn.name} '
+                    'with no multihost ordering edge (barrier / '
+                    'agree_emergency / sync_global_devices) in the same '
+                    'function: peers can race past the rank-0 mutation',
+                ))
+    return findings
+
+
+# ----------------------------------------------------------------- KFL005
+
+_CALLBACK_FUNCS = frozenset({'io_callback'})
+_PURE_CALLBACK_FUNCS = frozenset({'pure_callback'})
+
+
+def check_callback_discipline(project: core.Project) -> list[core.Finding]:
+    """KFL005: host callbacks with implicit semantics.
+
+    - ``io_callback(...)`` without an explicit ``ordered=`` kwarg: the
+      default (unordered) is usually what you want inside ``lax.cond``
+      over sharded operands — the async_inverse host path documents why
+      — but it must be *stated*, because flipping it changes whether XLA
+      may elide or reorder the effect across steps;
+    - a ``pure_callback`` call whose result is discarded (a bare
+      expression statement): pure callbacks are dead-code-eliminated
+      when unused, so the callback silently never runs.
+    """
+    findings: list[core.Finding] = []
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                name = core.call_name(node.func)
+                if name in _CALLBACK_FUNCS and not any(
+                    kw.arg == 'ordered' for kw in node.keywords
+                ):
+                    findings.append(core.finding_at(
+                        mod, node, 'KFL005',
+                        'io_callback without an explicit ordered= '
+                        'kwarg: state the ordering intent (ordered=False '
+                        'is required under lax.cond with sharded '
+                        'operands; ordered=True serializes steps)',
+                    ))
+            if isinstance(node, ast.Expr) and isinstance(
+                node.value, ast.Call
+            ):
+                if core.call_name(node.value.func) in _PURE_CALLBACK_FUNCS:
+                    findings.append(core.finding_at(
+                        mod, node.value, 'KFL005',
+                        'pure_callback result discarded: unused pure '
+                        'callbacks are eliminated by XLA and never run; '
+                        'use io_callback for effects',
+                    ))
+    return findings
+
+
+core.register(core.Rule(
+    code='KFL002',
+    name='rank-divergent-io',
+    what='file writes / `os.replace` / directory mutation under a '
+         '`process_index()` guard with no `multihost.barrier` or '
+         '`agree_emergency` ordering edge in the same function',
+    why='the PR-4 review found exactly this race in emergency-checkpoint '
+        'rotation: rank 0 rotated directories while peers raced into '
+        'restore and read a half-rotated tree',
+    check=check_rank_divergent_io,
+))
+
+core.register(core.Rule(
+    code='KFL005',
+    name='callback-discipline',
+    what='`io_callback` with `ordered=` unstated, and `pure_callback` '
+         'results that are discarded',
+    why='the async-inverse host path crashes XLA sharding propagation '
+        'if its io_callback is ordered under lax.cond, and an unused '
+        'pure_callback is silently elided — both defaults are landmines '
+        'unless written out',
+    check=check_callback_discipline,
+))

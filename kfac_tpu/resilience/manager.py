@@ -239,10 +239,12 @@ class CheckpointManager:
             return
         latest = self._latest_path()
         tmp = f'{latest}.tmp.{os.getpid()}'
+        # kfaclint: disable=KFL002 (LATEST is written by rank 0 strictly after wait_until_finished; peers only read it at restore entry)
         with open(tmp, 'w') as f:
             f.write(os.path.basename(self.step_dir(step)) + '\n')
             f.flush()
             os.fsync(f.fileno())
+        # kfaclint: disable=KFL002 (atomic pointer flip, same single-writer argument as the tmp write above)
         os.replace(tmp, latest)
         self._prune(protect=step)
 

@@ -1,0 +1,527 @@
+"""kfaclint analyzer suite: per-rule fixtures, suppressions, baseline,
+reporters, and the registry/doc contract.
+
+Every KFL001–KFL005 rule is demonstrated by a true-positive fixture that
+is asserted to flag *under that rule* and to be clean under every other
+AST rule — so disabling (unregistering) a rule makes its fixture test
+fail, which is the acceptance bar in docs/ANALYSIS.md.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from kfac_tpu import analysis
+from kfac_tpu.analysis import core, drift
+
+
+def run_snippet(tmp_path, source, codes=None, filename='mod.py'):
+    """Write ``source`` into a scratch project and analyze it."""
+    path = tmp_path / filename
+    path.write_text(textwrap.dedent(source))
+    project, errors = analysis.load_project(str(tmp_path))
+    rules = analysis.get_rules(codes or analysis.AST_RULE_CODES)
+    return analysis.analyze(project, rules, parse_errors=errors)
+
+
+def codes_of(findings):
+    return sorted({f.code for f in findings})
+
+
+OTHER = {
+    code: [c for c in analysis.AST_RULE_CODES if c != code]
+    for code in analysis.AST_RULE_CODES
+}
+
+
+# ------------------------------------------------------------------ KFL001
+
+
+KFL001_TP = '''
+    from kfac_tpu import tracing
+
+    @tracing.scope('kfac.step')
+    def step(state, grads):
+        scale = float(grads)
+        return _apply(state, scale)
+
+    def _apply(state, scale):
+        return state.loss.item() + scale
+'''
+
+
+def test_kfl001_flags_host_sync(tmp_path):
+    findings = run_snippet(tmp_path, KFL001_TP, ['KFL001'])
+    msgs = [f.message for f in findings]
+    # float() on the traced param at the entry point itself...
+    assert any('float()' in m and 'step' in m for m in msgs), msgs
+    # ...and .item() in a helper reached through the call graph
+    assert any('.item()' in m and '_apply' in m for m in msgs), msgs
+
+
+def test_kfl001_silent_when_disabled(tmp_path):
+    assert run_snippet(tmp_path, KFL001_TP, OTHER['KFL001']) == []
+
+
+def test_kfl001_clean_negatives(tmp_path):
+    # host-side code (no scope/jit decorator) may sync freely; nested
+    # defs handed to io_callback run on the host; float() on config
+    # plumbing is trace-time constant folding
+    assert run_snippet(tmp_path, '''
+        import numpy as np
+        from jax.experimental import io_callback
+        from kfac_tpu import tracing
+
+        def drain(state):
+            return float(np.asarray(state.loss))
+
+        @tracing.scope('kfac.launch')
+        def launch(x, cfg):
+            def compute(arr):
+                return float(np.asarray(arr))
+            damp = float(cfg.damping)
+            return io_callback(compute, None, x), damp
+    ''', ['KFL001']) == []
+
+
+def test_kfl001_reaches_through_lax_cond_branch(tmp_path):
+    # a function passed as a lax.cond branch is in-jit even though it is
+    # never called by name
+    findings = run_snippet(tmp_path, '''
+        from jax import lax
+        from kfac_tpu import tracing
+
+        def _branch(x):
+            return x.item()
+
+        def _noop(x):
+            return x
+
+        @tracing.scope('kfac.maybe')
+        def maybe(pred, x):
+            return lax.cond(pred, _branch, _noop, x)
+    ''', ['KFL001'])
+    assert any('_branch' in f.message for f in findings), findings
+
+
+# ------------------------------------------------------------------ KFL002
+
+
+KFL002_TP = '''
+    import os
+    import jax
+
+    def commit(path):
+        if jax.process_index() != 0:
+            return
+        os.replace(path + '.tmp', path)
+'''
+
+
+def test_kfl002_flags_unordered_rank0_io(tmp_path):
+    findings = run_snippet(tmp_path, KFL002_TP, ['KFL002'])
+    assert codes_of(findings) == ['KFL002']
+    assert 'os.replace()' in findings[0].message
+
+
+def test_kfl002_silent_when_disabled(tmp_path):
+    assert run_snippet(tmp_path, KFL002_TP, OTHER['KFL002']) == []
+
+
+def test_kfl002_guard_form_a(tmp_path):
+    findings = run_snippet(tmp_path, '''
+        import shutil
+        import jax
+
+        def rotate(d):
+            if jax.process_index() == 0:
+                shutil.rmtree(d)
+    ''', ['KFL002'])
+    assert any('shutil.rmtree()' in f.message for f in findings)
+
+
+def test_kfl002_clean_with_ordering_edge(tmp_path):
+    # the PR-4 fix shape: rank-0 mutation ordered by an explicit barrier
+    assert run_snippet(tmp_path, '''
+        import os
+        import jax
+        from kfac_tpu.parallel import multihost
+
+        def commit(path, step):
+            if jax.process_index() == 0:
+                os.replace(path + '.tmp', path)
+            multihost.barrier(f'commit-{step}')
+    ''', ['KFL002']) == []
+
+
+def test_kfl002_clean_without_rank_guard(tmp_path):
+    # symmetric I/O (every rank writes its own file) is not this race
+    assert run_snippet(tmp_path, '''
+        import os
+
+        def spill(path):
+            os.replace(path + '.tmp', path)
+    ''', ['KFL002']) == []
+
+
+# ------------------------------------------------------------------ KFL003
+
+
+KFL003_TP = '''
+    import jax
+
+    @jax.tree_util.register_pytree_node_class
+    class S:
+        def __init__(self, names, a, b):
+            self.names = names
+            self.a = a
+            self.b = b
+
+        def tree_flatten(self):
+            return ((self.b, self.a), (self.names,))
+
+        @classmethod
+        def tree_unflatten(cls, aux, children):
+            (names,) = aux
+            return cls(names, *children)
+'''
+
+
+def test_kfl003_flags_scrambled_flatten_order(tmp_path):
+    findings = run_snippet(tmp_path, KFL003_TP, ['KFL003'])
+    assert codes_of(findings) == ['KFL003']
+    assert 'field order' in findings[0].message
+
+
+def test_kfl003_silent_when_disabled(tmp_path):
+    assert run_snippet(tmp_path, KFL003_TP, OTHER['KFL003']) == []
+
+
+def test_kfl003_clean_consistent_pytree(tmp_path):
+    assert run_snippet(tmp_path, KFL003_TP.replace(
+        '((self.b, self.a), (self.names,))',
+        '((self.a, self.b), (self.names,))',
+    ), ['KFL003']) == []
+
+
+def test_kfl003_durable_state_reading_ephemeral(tmp_path):
+    findings = run_snippet(tmp_path, '''
+        from typing import Any, NamedTuple
+
+        class KState(NamedTuple):
+            step: Any
+            a: Any
+            metrics: Any = None
+
+        def durable_state(state):
+            return {'step': state.step, 'metrics': state.metrics}
+    ''', ['KFL003'])
+    assert any('metrics' in f.message and 'durable_state' in f.message
+               for f in findings), findings
+
+
+def test_kfl003_durable_state_getattr_guard_is_clean(tmp_path):
+    assert run_snippet(tmp_path, '''
+        from typing import Any, NamedTuple
+
+        class KState(NamedTuple):
+            step: Any
+            a: Any
+            metrics: Any = None
+
+        def durable_state(state):
+            out = {'step': state.step, 'a': state.a}
+            m = getattr(state, 'metrics', None)
+            if m is not None:
+                out['metrics'] = m
+            return out
+    ''', ['KFL003']) == []
+
+
+def test_kfl003_state_shardings_missing_field(tmp_path):
+    findings = run_snippet(tmp_path, '''
+        from typing import Any, NamedTuple
+
+        class KState(NamedTuple):
+            step: Any
+            a: Any
+            shadow: Any = None
+
+        def state_shardings(rep):
+            return KState(step=rep, a=rep)
+    ''', ['KFL003'])
+    assert any('shadow' in f.message for f in findings), findings
+
+
+# ------------------------------------------------------------------ KFL004
+
+
+KFL004_TP = '''
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=('cfg',))
+    def step(x, cfg: dict):
+        if x:
+            return x
+        return x
+'''
+
+
+def test_kfl004_flags_dict_static_and_truthiness(tmp_path):
+    findings = run_snippet(tmp_path, KFL004_TP, ['KFL004'])
+    msgs = [f.message for f in findings]
+    assert any('static arg' in m and "'cfg'" in m for m in msgs), msgs
+    assert any('truthiness' in m and "'x'" in m for m in msgs), msgs
+
+
+def test_kfl004_silent_when_disabled(tmp_path):
+    assert run_snippet(tmp_path, KFL004_TP, OTHER['KFL004']) == []
+
+
+def test_kfl004_clean_static_branch(tmp_path):
+    # branching on a declared-static parameter is exactly what statics
+    # are for; hashable statics are fine
+    assert run_snippet(tmp_path, '''
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=('flag',))
+        def step(x, flag):
+            if flag:
+                return x + 1
+            return x
+    ''', ['KFL004']) == []
+
+
+def test_kfl004_dict_literal_static_kwarg(tmp_path):
+    findings = run_snippet(tmp_path, '''
+        import jax
+
+        def build(f):
+            return jax.jit(f, static_argnames={'cfg': 1})
+    ''', ['KFL004'])
+    assert any('dict literal' in f.message for f in findings)
+
+
+# ------------------------------------------------------------------ KFL005
+
+
+KFL005_TP = '''
+    from jax.experimental import io_callback
+
+    def launch(cb, x):
+        return io_callback(cb, None, x)
+'''
+
+
+def test_kfl005_flags_unstated_ordering(tmp_path):
+    findings = run_snippet(tmp_path, KFL005_TP, ['KFL005'])
+    assert codes_of(findings) == ['KFL005']
+    assert 'ordered=' in findings[0].message
+
+
+def test_kfl005_silent_when_disabled(tmp_path):
+    assert run_snippet(tmp_path, KFL005_TP, OTHER['KFL005']) == []
+
+
+def test_kfl005_clean_with_explicit_ordered(tmp_path):
+    assert run_snippet(tmp_path, KFL005_TP.replace(
+        'io_callback(cb, None, x)', 'io_callback(cb, None, x, ordered=False)'
+    ), ['KFL005']) == []
+
+
+def test_kfl005_discarded_pure_callback(tmp_path):
+    findings = run_snippet(tmp_path, '''
+        import jax
+
+        def f(cb, shape, x):
+            jax.pure_callback(cb, shape, x)
+            return x
+    ''', ['KFL005'])
+    assert any('discarded' in f.message for f in findings)
+
+
+# ------------------------------------------------------------- suppressions
+
+
+def test_suppression_with_reason_silences(tmp_path):
+    assert run_snippet(tmp_path, KFL005_TP.replace(
+        'return io_callback(cb, None, x)',
+        'return io_callback(cb, None, x)  '
+        '# kfaclint: disable=KFL005 (test fixture: ordering irrelevant)',
+    ), ['KFL005']) == []
+
+
+def test_standalone_suppression_covers_next_line(tmp_path):
+    assert run_snippet(tmp_path, KFL005_TP.replace(
+        'return io_callback(cb, None, x)',
+        '# kfaclint: disable=KFL005 (test fixture: ordering irrelevant)\n'
+        '        return io_callback(cb, None, x)',
+    ), ['KFL005']) == []
+
+
+def test_reasonless_suppression_is_kfl000_and_does_not_silence(tmp_path):
+    findings = run_snippet(tmp_path, KFL005_TP.replace(
+        'return io_callback(cb, None, x)',
+        'return io_callback(cb, None, x)  # kfaclint: disable=KFL005',
+    ), ['KFL005'])
+    assert 'KFL000' in codes_of(findings)
+    assert 'KFL005' in codes_of(findings)  # still reported
+
+
+def test_malformed_directive_is_kfl000(tmp_path):
+    findings = run_snippet(
+        tmp_path, 'x = 1  # kfaclint: disbale=KFL005 (typo)\n', ['KFL005']
+    )
+    assert codes_of(findings) == ['KFL000']
+    assert 'malformed' in findings[0].message
+
+
+def test_kfl000_cannot_be_suppressed(tmp_path):
+    findings = run_snippet(
+        tmp_path,
+        'x = 1  # kfaclint: disable=KFL000,KFL005\n',
+        ['KFL005'],
+    )
+    assert 'KFL000' in codes_of(findings)
+
+
+def test_mentions_in_strings_are_not_directives(tmp_path):
+    assert run_snippet(tmp_path, '''
+        MSG = "write a '# kfaclint: disable=CODE (reason)' comment"
+    ''', ['KFL005']) == []
+
+
+def test_parse_error_becomes_finding(tmp_path):
+    findings = run_snippet(tmp_path, 'def broken(:\n', ['KFL005'])
+    assert codes_of(findings) == ['KFL000']
+    assert 'does not parse' in findings[0].message
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = run_snippet(tmp_path, KFL002_TP, ['KFL002'])
+    assert findings
+    bpath = str(tmp_path / 'baseline.json')
+    analysis.save_baseline(bpath, findings)
+    loaded = analysis.load_baseline(bpath)
+    new, matched = analysis.split_baseline(findings, loaded)
+    assert new == [] and matched == len(findings)
+
+
+def test_baseline_is_line_number_tolerant(tmp_path):
+    findings = run_snippet(tmp_path, KFL002_TP, ['KFL002'])
+    bpath = str(tmp_path / 'baseline.json')
+    analysis.save_baseline(bpath, findings)
+    shifted = [
+        core.Finding(path=f.path, line=f.line + 40, code=f.code,
+                     message=f.message)
+        for f in findings
+    ]
+    new, matched = analysis.split_baseline(
+        shifted, analysis.load_baseline(bpath)
+    )
+    assert new == [] and matched == len(findings)
+
+
+def test_baseline_entries_consumed_once(tmp_path):
+    findings = run_snippet(tmp_path, KFL002_TP, ['KFL002'])
+    bpath = str(tmp_path / 'baseline.json')
+    analysis.save_baseline(bpath, findings)
+    doubled = findings + [
+        core.Finding(path=f.path, line=f.line + 7, code=f.code,
+                     message=f.message)
+        for f in findings
+    ]
+    new, matched = analysis.split_baseline(
+        doubled, analysis.load_baseline(bpath)
+    )
+    assert matched == len(findings) and len(new) == len(findings)
+
+
+def test_baseline_schema_mismatch_rejected(tmp_path):
+    bpath = tmp_path / 'baseline.json'
+    bpath.write_text(json.dumps({'schema': 99, 'findings': []}))
+    with pytest.raises(ValueError, match='schema'):
+        analysis.load_baseline(str(bpath))
+
+
+def test_checked_in_baseline_is_empty():
+    repo = os.path.join(os.path.dirname(__file__), os.pardir)
+    loaded = analysis.load_baseline(
+        os.path.join(repo, 'tools', 'kfaclint_baseline.json')
+    )
+    assert loaded == []
+
+
+# ---------------------------------------------------------------- reporters
+
+
+def test_json_reporter_schema(tmp_path):
+    findings = run_snippet(tmp_path, KFL002_TP, ['KFL002'])
+    payload = json.loads(
+        analysis.render_json(findings, baselined=2, checked=5)
+    )
+    assert payload['schema'] == 1
+    assert payload['tool'] == 'kfaclint'
+    assert payload['summary'] == {
+        'total': len(findings),
+        'baselined': 2,
+        'files_checked': 5,
+        'by_code': {'KFL002': len(findings)},
+    }
+    for entry in payload['findings']:
+        assert set(entry) == {'code', 'rule', 'path', 'line', 'col',
+                              'message'}
+        assert entry['rule'] == 'rank-divergent-io'
+
+
+def test_text_reporter_renders_location(tmp_path):
+    findings = run_snippet(tmp_path, KFL002_TP, ['KFL002'])
+    text = analysis.render_text(findings, baselined=1, checked=3)
+    assert 'mod.py:' in text and 'KFL002' in text
+    assert '1 baselined' in text and '3 file(s)' in text
+
+
+# ----------------------------------------------------------------- registry
+
+
+def test_registry_rejects_unknown_code():
+    with pytest.raises(KeyError, match='KFL999'):
+        analysis.get_rules(['KFL999'])
+
+
+def test_registry_rejects_duplicate_registration():
+    rule = analysis.all_rules()[0]
+    with pytest.raises(ValueError, match='duplicate'):
+        analysis.register(rule)
+
+
+def test_all_ast_and_project_rules_registered():
+    codes = {r.code for r in analysis.all_rules()}
+    assert set(analysis.AST_RULE_CODES) <= codes
+    assert set(analysis.PROJECT_RULE_CODES) <= codes
+
+
+def test_doc_rule_table_in_sync():
+    # KFL100 on the real repo doc: every registered rule has a row with
+    # the exact registry name, and no stale rows
+    assert drift.check_rule_table() == []
+
+
+def test_repo_is_clean_under_ast_rules():
+    # the acceptance bar: zero findings on kfac_tpu/ at head with the
+    # checked-in (empty) baseline — suppressions must carry reasons
+    project, errors = analysis.load_project(
+        drift.REPO_ROOT, targets=['kfac_tpu']
+    )
+    findings = analysis.analyze(
+        project, analysis.get_rules(analysis.AST_RULE_CODES),
+        parse_errors=errors,
+    )
+    assert findings == [], [f.render() for f in findings]

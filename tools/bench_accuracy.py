@@ -43,7 +43,10 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common
+
+sys.path.insert(0, _common.repo_root())
 
 import flax.linen as nn
 import jax
@@ -81,7 +84,7 @@ class SmallCNN(nn.Module):
 def _docs_corpus(max_bytes: int = 400_000) -> np.ndarray:
     """Byte tokens from the repo's own markdown/docs — real English text
     that ships offline with the repo."""
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = _common.repo_root()
     paths = [os.path.join(root, 'README.md'), os.path.join(root, 'SURVEY.md')]
     docs_dir = os.path.join(root, 'docs')
     if os.path.isdir(docs_dir):

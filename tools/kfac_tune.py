@@ -234,9 +234,9 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     _pin_host_platform()
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    if repo_root not in sys.path:
-        sys.path.insert(0, repo_root)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import _common
+    _common.bootstrap()
 
     if args.selftest:
         return selftest()

@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""kfaclint: the repo's unified static-analysis / lint entry point.
+
+Runs the AST rules (KFL001–KFL005: host-sync-in-jit, rank-divergent
+I/O, ephemeral-pytree drift, recompile hazards, callback discipline)
+over ``kfac_tpu/``, and with ``--all`` also the docs-vs-code drift rules
+(KFL100–KFL104) that the four ``tools/lint_*.py`` wrappers delegate to.
+See docs/ANALYSIS.md for the rule table and suppression syntax.
+
+    JAX_PLATFORMS=cpu python tools/kfaclint.py --all        # CI entry
+    python tools/kfaclint.py --rules KFL002 kfac_tpu/checkpoint.py
+    python tools/kfaclint.py --list-rules
+    python tools/kfaclint.py --selftest
+
+Exit codes: 0 clean (or only-baselined), 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: E402
+
+BASELINE_DEFAULT = os.path.join(_common.repo_root(), 'tools',
+                                'kfaclint_baseline.json')
+
+
+# ---------------------------------------------------------------- selftest
+#
+# Small end-to-end fixtures, one true positive and one clean negative per
+# AST rule, run through the real load_project/analyze pipeline in a temp
+# dir. tests/test_kfaclint.py holds the richer suite; this is the
+# no-pytest smoke check the Makefile runs (kfac_inspect.py convention).
+
+_FIXTURES: dict[str, tuple[str, str]] = {
+    'KFL001': (
+        # TP: float() on a traced param inside a scoped entry point
+        '''
+from kfac_tpu import tracing
+
+@tracing.scope('k.step')
+def step(state, grads):
+    return float(grads) + 1.0
+''',
+        # negative: same sync, but host-side (no scope/jit decorator)
+        '''
+def drain(grads):
+    return float(grads)
+''',
+    ),
+    'KFL002': (
+        '''
+import os
+import jax
+
+def commit(path):
+    if jax.process_index() != 0:
+        return
+    os.replace(path + '.tmp', path)
+''',
+        '''
+import os
+import jax
+from kfac_tpu.parallel import multihost
+
+def commit(path):
+    if jax.process_index() != 0:
+        return
+    os.replace(path + '.tmp', path)
+    multihost.barrier('commit')
+''',
+    ),
+    'KFL003': (
+        '''
+import jax
+
+@jax.tree_util.register_pytree_node_class
+class S:
+    def __init__(self, names, a, b):
+        self.names = names
+        self.a = a
+        self.b = b
+
+    def tree_flatten(self):
+        return ((self.b, self.a), (self.names,))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        (names,) = aux
+        return cls(names, *children)
+''',
+        '''
+import jax
+
+@jax.tree_util.register_pytree_node_class
+class S:
+    def __init__(self, names, a, b):
+        self.names = names
+        self.a = a
+        self.b = b
+
+    def tree_flatten(self):
+        return ((self.a, self.b), (self.names,))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        (names,) = aux
+        return cls(names, *children)
+''',
+    ),
+    'KFL004': (
+        '''
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=('cfg',))
+def step(x, cfg: dict):
+    if x:
+        return x
+    return x
+''',
+        '''
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=('flag',))
+def step(x, flag):
+    if flag:
+        return x + 1
+    return x
+''',
+    ),
+    'KFL005': (
+        '''
+from jax.experimental import io_callback
+
+def launch(cb, x):
+    return io_callback(cb, None, x)
+''',
+        '''
+from jax.experimental import io_callback
+
+def launch(cb, x):
+    return io_callback(cb, None, x, ordered=False)
+''',
+    ),
+}
+
+
+def _run_fixture(analysis, tmp: str, source: str, codes: list[str]):
+    path = os.path.join(tmp, 'mod.py')
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(source)
+    project, errs = analysis.load_project(tmp)
+    return analysis.analyze(
+        project, analysis.get_rules(codes), parse_errors=errs
+    )
+
+
+def selftest() -> int:
+    import tempfile
+
+    from kfac_tpu import analysis
+
+    for code, (positive, negative) in sorted(_FIXTURES.items()):
+        with tempfile.TemporaryDirectory() as tmp:
+            hits = _run_fixture(analysis, tmp, positive, [code])
+            assert any(f.code == code for f in hits), (
+                f'{code}: true-positive fixture produced no finding'
+            )
+        with tempfile.TemporaryDirectory() as tmp:
+            hits = _run_fixture(analysis, tmp, negative, [code])
+            assert not hits, (
+                f'{code}: clean fixture flagged: '
+                + '; '.join(f.render() for f in hits)
+            )
+
+    # suppression with a reason silences; without one becomes KFL000
+    with tempfile.TemporaryDirectory() as tmp:
+        tp = _FIXTURES['KFL005'][0].replace(
+            'return io_callback(cb, None, x)',
+            'return io_callback(cb, None, x)  '
+            '# kfaclint: disable=KFL005 (fixture: ordering irrelevant)',
+        )
+        assert not _run_fixture(analysis, tmp, tp, ['KFL005'])
+    with tempfile.TemporaryDirectory() as tmp:
+        tp = _FIXTURES['KFL005'][0].replace(
+            'return io_callback(cb, None, x)',
+            'return io_callback(cb, None, x)  # kfaclint: disable=KFL005',
+        )
+        hits = _run_fixture(analysis, tmp, tp, ['KFL005'])
+        assert any(f.code == 'KFL000' for f in hits), hits
+
+    # baseline round-trip
+    with tempfile.TemporaryDirectory() as tmp:
+        findings = _run_fixture(
+            analysis, tmp, _FIXTURES['KFL002'][0], ['KFL002']
+        )
+        bpath = os.path.join(tmp, 'baseline.json')
+        analysis.save_baseline(bpath, findings)
+        new, matched = analysis.split_baseline(
+            findings, analysis.load_baseline(bpath)
+        )
+        assert not new and matched == len(findings)
+
+    # JSON reporter schema
+    payload = json.loads(analysis.render_json([], baselined=0, checked=3))
+    assert payload['schema'] == 1 and payload['tool'] == 'kfaclint'
+    assert payload['summary']['files_checked'] == 3
+
+    print('kfaclint selftest ok: '
+          f'{len(_FIXTURES)} rule fixtures, suppressions, baseline, json')
+    return 0
+
+
+# -------------------------------------------------------------------- main
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    parser.add_argument('targets', nargs='*',
+                        help='files/dirs to analyze (default: kfac_tpu/)')
+    parser.add_argument('--all', action='store_true',
+                        help='also run the project drift rules '
+                             '(KFL100-KFL104: docs-vs-code)')
+    parser.add_argument('--rules',
+                        help='comma-separated rule codes to run '
+                             '(default: all AST rules)')
+    parser.add_argument('--json', action='store_true',
+                        help='emit the report as JSON instead of text')
+    parser.add_argument('--baseline', default=BASELINE_DEFAULT,
+                        help='baseline file (default: '
+                             'tools/kfaclint_baseline.json)')
+    parser.add_argument('--update-baseline', action='store_true',
+                        help='rewrite the baseline to the current '
+                             'findings and exit 0')
+    parser.add_argument('--list-rules', action='store_true',
+                        help='print the rule registry and exit')
+    parser.add_argument('--selftest', action='store_true',
+                        help='run the built-in rule fixtures and exit')
+    args = parser.parse_args(argv)
+
+    root = _common.bootstrap()
+    if args.selftest:
+        return selftest()
+
+    from kfac_tpu import analysis
+
+    if args.list_rules:
+        for rule in analysis.all_rules():
+            print(f'{rule.code}  [{rule.kind:>7}]  {rule.name}')
+            print(f'        {rule.what}')
+        return 0
+
+    try:
+        if args.rules:
+            rules = analysis.get_rules(args.rules.split(','))
+        elif args.all:
+            rules = analysis.all_rules()
+        else:
+            rules = analysis.get_rules(analysis.AST_RULE_CODES)
+    except KeyError as exc:
+        print(exc.args[0], file=sys.stderr)
+        return 2
+
+    targets = args.targets or ['kfac_tpu']
+    project, parse_errors = analysis.load_project(root, targets)
+    findings = analysis.analyze(project, rules, parse_errors=parse_errors)
+
+    if args.update_baseline:
+        analysis.save_baseline(args.baseline, findings)
+        print(f'baseline updated: {len(findings)} finding(s) -> '
+              f'{args.baseline}')
+        return 0
+
+    baseline = analysis.load_baseline(args.baseline)
+    new, matched = analysis.split_baseline(findings, baseline)
+    render = analysis.render_json if args.json else analysis.render_text
+    print(render(new, baselined=matched, checked=len(project.modules)))
+    return 1 if new else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,92 +1,43 @@
 #!/usr/bin/env python
 """Lint: the metric-key tables in docs/OBSERVABILITY.md match the code.
 
-The drained-record schema is a *contract* — dashboards, the flight
-recorder's ring columns, and ``tools/kfac_inspect.py`` all key off it —
-so the documentation tables under '### Metric-key schema' must stay in
-lockstep with :func:`kfac_tpu.observability.metric_keys` and
-:func:`kfac_tpu.health.health_metric_keys`. This script parses the
-backticked keys out of those two tables (``<layer>`` rows compared with a
-literal ``<layer>`` placeholder name) and fails on any drift in either
-direction.
+Thin wrapper kept for ``make obs`` and existing imports; the check now
+lives in the kfaclint registry as rule **KFL102** (see
+``kfac_tpu/analysis/drift.py`` and docs/ANALYSIS.md). Prefer:
 
-Run via ``make obs`` (CPU-pinned) or directly:
-
-    JAX_PLATFORMS=cpu python tools/lint_metric_keys.py
+    JAX_PLATFORMS=cpu python tools/kfaclint.py --rules KFL102
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-DOC = 'docs/OBSERVABILITY.md'
-SECTION = '### Metric-key schema'
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: E402
 
-#: documented keys that are drain-record fields, not metric_keys entries
-EXTRA_DOC_KEYS = {'step'}
+_common.bootstrap()
 
+from kfac_tpu.analysis import drift  # noqa: E402
 
-def _doc_section(text: str) -> str:
-    start = text.index(SECTION)
-    rest = text[start + len(SECTION):]
-    m = re.search(r'^#{2,3} ', rest, re.MULTILINE)
-    return rest[: m.start()] if m else rest
-
-
-def doc_keys(doc_path: str) -> set[str]:
-    """Backticked keys from the first column of the section's tables."""
-    with open(doc_path) as f:
-        section = _doc_section(f.read())
-    keys: set[str] = set()
-    for line in section.splitlines():
-        line = line.strip()
-        # table rows whose first cell is one or more `key` tokens; the
-        # header/separator rows and prose paragraphs never match
-        if not line.startswith('| `'):
-            continue
-        first_cell = line.split('|')[1]
-        keys.update(re.findall(r'`([^`]+)`', first_cell))
-    return keys
-
-
-def code_keys() -> set[str]:
-    from kfac_tpu import health
-    from kfac_tpu.observability import metrics as metrics_lib
-
-    names = ['<layer>']
-    keys = set(metrics_lib.metric_keys(metrics_lib.MetricsConfig(), names))
-    keys |= set(health.health_metric_keys(names))
-    return keys | EXTRA_DOC_KEYS
+DOC = drift.OBSERVABILITY_DOC
 
 
 def check(doc_path: str = DOC) -> list[str]:
     """Return human-readable drift complaints (empty = in sync)."""
-    documented = doc_keys(doc_path)
-    actual = code_keys()
-    problems = []
-    for k in sorted(actual - documented):
-        problems.append(f'undocumented key (add to {DOC}): {k}')
-    for k in sorted(documented - actual):
-        problems.append(f'documented key not produced by the code: {k}')
-    return problems
+    return drift.check_metric_keys(doc_path)
 
 
 def main() -> int:
-    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
-    # the repo is not pip-installed; make `python tools/...` work from root
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    if repo_root not in sys.path:
-        sys.path.insert(0, repo_root)
-    os.chdir(repo_root)
     problems = check()
     if problems:
         print('metric-key schema drift between code and docs:')
         for p in problems:
             print(f'  {p}')
         return 1
-    print(f'metric-key lint ok: {len(doc_keys(DOC))} documented keys '
+    section, _ = drift.doc_section(DOC, '### Metric-key schema')
+    n = len(drift.table_first_cells(section))
+    print(f'metric-key lint ok: {n} documented keys '
           'match metric_keys() + health_metric_keys()')
     return 0
 

@@ -1,80 +1,34 @@
 #!/usr/bin/env python
 """Lint: every public jitted engine entry point carries a named scope.
 
-The observability spine (docs/OBSERVABILITY.md) relies on the engines'
-hot paths being wrapped in ``jax.named_scope`` — that is what makes XLA
-profiler captures attribute device time to K-FAC phases. Both
-``kfac_tpu.tracing.trace`` and ``kfac_tpu.tracing.scope`` stamp a
-``__kfac_scope__`` attribute on the functions they wrap; this script
-asserts the attribute is present on every entry point below so a
-refactor cannot silently drop the annotation.
+Thin wrapper kept for ``make obs`` and existing imports; the check now
+lives in the kfaclint registry as rule **KFL101** (see
+``kfac_tpu/analysis/drift.py`` and docs/ANALYSIS.md). Prefer:
 
-Run via ``make obs`` (CPU-pinned) or directly:
-
-    JAX_PLATFORMS=cpu python tools/lint_named_scopes.py
+    JAX_PLATFORMS=cpu python tools/kfaclint.py --rules KFL101
 """
 
 from __future__ import annotations
 
-import importlib
-import inspect
 import os
 import sys
 
-# (module, class-or-None, callables that must carry __kfac_scope__);
-# a None class means module-level functions
-TARGETS: list[tuple[str, str | None, tuple[str, ...]]] = [
-    (
-        'kfac_tpu.preconditioner',
-        'KFACPreconditioner',
-        ('step', 'update_factors', 'update_inverses', 'precondition'),
-    ),
-    (
-        'kfac_tpu.parallel.kaisa',
-        'DistributedKFAC',
-        ('step', 'update_factors', 'update_inverses', 'precondition'),
-    ),
-    (
-        'kfac_tpu.training',
-        'Trainer',
-        ('step', 'scan_steps', 'step_accumulate', 'step_accumulate_scan'),
-    ),
-    (
-        'kfac_tpu.async_inverse.sliced',
-        None,
-        ('dense_async_step', 'kaisa_async_step'),
-    ),
-    (
-        'kfac_tpu.async_inverse.host',
-        None,
-        ('dense_host_step', 'kaisa_host_step', 'pump'),
-    ),
-]
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: E402
+
+_common.bootstrap()
+
+from kfac_tpu.analysis import drift  # noqa: E402
+
+TARGETS = drift.SCOPE_TARGETS
 
 
 def check() -> list[str]:
     """Return a list of 'module.Class.method' strings missing a scope."""
-    missing: list[str] = []
-    for mod_name, cls_name, methods in TARGETS:
-        mod = importlib.import_module(mod_name)
-        holder = mod if cls_name is None else getattr(mod, cls_name)
-        for meth in methods:
-            # getattr_static avoids triggering descriptors/binding; the
-            # decorators stamp the underlying function object.
-            fn = inspect.getattr_static(holder, meth)
-            fn = getattr(fn, '__func__', fn)
-            if not getattr(fn, '__kfac_scope__', None):
-                where = mod_name if cls_name is None else f'{mod_name}.{cls_name}'
-                missing.append(f'{where}.{meth}')
-    return missing
+    return drift.check_named_scopes()
 
 
 def main() -> int:
-    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
-    # the repo is not pip-installed; make `python tools/...` work from root
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    if repo_root not in sys.path:
-        sys.path.insert(0, repo_root)
     missing = check()
     if missing:
         print('missing named scopes (tracing.trace/tracing.scope):')

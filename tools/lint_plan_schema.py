@@ -1,79 +1,41 @@
 #!/usr/bin/env python
 """Drift guard: docs/AUTOTUNE.md's plan-schema table vs the code.
 
-The TunedPlan JSON schema is documented as a table in docs/AUTOTUNE.md
-(section '### Plan schema'). The set of keys the code actually
-serializes is ``kfac_tpu.autotune.plan.plan_schema_keys()`` — the
-top-level plan fields plus one ``knobs.<name>`` entry per knob. This
-lint fails when either side drifts: a field added to the plan without a
-doc row, or a documented field the code no longer produces.
+Thin wrapper kept for ``make tune`` / ``make obs`` and existing imports;
+the check now lives in the kfaclint registry as rule **KFL103** (see
+``kfac_tpu/analysis/drift.py`` and docs/ANALYSIS.md). Prefer:
 
-Run directly or via ``make tune`` / ``make obs``.
+    JAX_PLATFORMS=cpu python tools/kfaclint.py --rules KFL103
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-DOC = 'docs/AUTOTUNE.md'
-SECTION = '### Plan schema'
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: E402
 
+_common.bootstrap()
 
-def _doc_section(text: str) -> str:
-    """The plan-schema section body (up to the next heading)."""
-    try:
-        start = text.index(SECTION)
-    except ValueError:
-        raise SystemExit(f'{DOC} has no "{SECTION}" section')
-    rest = text[start + len(SECTION):]
-    nxt = re.search(r'^#{2,3} ', rest, re.MULTILINE)
-    return rest[: nxt.start()] if nxt else rest
+from kfac_tpu.analysis import drift  # noqa: E402
 
-
-def doc_keys(doc_path: str = DOC) -> set[str]:
-    with open(doc_path, encoding='utf-8') as f:
-        section = _doc_section(f.read())
-    keys: set[str] = set()
-    for line in section.splitlines():
-        line = line.strip()
-        if not line.startswith('| `'):
-            continue
-        first_cell = line.split('|')[1]
-        keys.update(re.findall(r'`([^`]+)`', first_cell))
-    return keys
-
-
-def code_keys() -> set[str]:
-    from kfac_tpu.autotune import plan as plan_lib
-
-    return set(plan_lib.plan_schema_keys())
+DOC = drift.AUTOTUNE_DOC
 
 
 def check(doc_path: str = DOC) -> list[str]:
-    documented = doc_keys(doc_path)
-    produced = code_keys()
-    complaints = []
-    for k in sorted(produced - documented):
-        complaints.append(f'undocumented plan field (add to {DOC}): {k}')
-    for k in sorted(documented - produced):
-        complaints.append(f'documented field not in the plan schema: {k}')
-    return complaints
+    return drift.check_plan_schema(doc_path)
 
 
 def main() -> int:
-    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    if repo_root not in sys.path:
-        sys.path.insert(0, repo_root)
-    os.chdir(repo_root)
     complaints = check()
     if complaints:
         print('\n'.join(complaints))
         return 1
+    section, _ = drift.doc_section(DOC, '### Plan schema')
+    n = len(drift.table_first_cells(section))
     print(
-        f'plan-schema lint ok: {len(doc_keys())} documented fields match '
+        f'plan-schema lint ok: {n} documented fields match '
         f'kfac_tpu.autotune.plan.plan_schema_keys()'
     )
     return 0

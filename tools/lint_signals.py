@@ -1,104 +1,42 @@
 #!/usr/bin/env python
 """Lint: the signal table in docs/ROBUSTNESS.md matches the handlers.
 
-The preemption-signal semantics are a *contract* — cluster launch
-scripts send SIGTERM/SIGUSR1 expecting exactly the documented behavior —
-so the table under '## Signal semantics' must stay in lockstep with
-:data:`kfac_tpu.resilience.signals.HANDLED_SIGNALS`. This script parses
-the backticked signal names and their exit-vs-continue semantics out of
-the table and fails on any drift in either direction: an undocumented
-handled signal, a documented-but-unhandled one, or a row whose
-exit/continue cell contradicts the handler's ``exits`` flag.
+Thin wrapper kept for ``make resilience`` and existing imports; the
+check now lives in the kfaclint registry as rule **KFL104** (see
+``kfac_tpu/analysis/drift.py`` and docs/ANALYSIS.md). Prefer:
 
-Run via ``make resilience`` (CPU-pinned) or directly:
-
-    JAX_PLATFORMS=cpu python tools/lint_signals.py
+    JAX_PLATFORMS=cpu python tools/kfaclint.py --rules KFL104
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-DOC = 'docs/ROBUSTNESS.md'
-SECTION = '## Signal semantics'
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: E402
 
+_common.bootstrap()
 
-def _doc_section(text: str) -> str:
-    start = text.index(SECTION)
-    rest = text[start + len(SECTION):]
-    m = re.search(r'^#{1,3} ', rest, re.MULTILINE)
-    return rest[: m.start()] if m else rest
+from kfac_tpu.analysis import drift  # noqa: E402
 
-
-def doc_signals(doc_path: str) -> dict[str, bool]:
-    """{signal name: exits} parsed from the section's table rows."""
-    with open(doc_path) as f:
-        section = _doc_section(f.read())
-    out: dict[str, bool] = {}
-    for line in section.splitlines():
-        line = line.strip()
-        # table rows whose first cell is a `SIGXXX` token; the header and
-        # separator rows never match
-        if not line.startswith('| `'):
-            continue
-        cells = line.split('|')
-        names = re.findall(r'`(SIG[A-Z0-9]+)`', cells[1])
-        if not names:
-            continue
-        semantics = cells[2].lower()
-        exits = 'exit' in semantics
-        if not exits and 'continue' not in semantics:
-            raise ValueError(
-                f'{doc_path}: signal-table row for {names} states neither '
-                f'"exit" nor "continue": {cells[2].strip()!r}'
-            )
-        for name in names:
-            out[name] = exits
-    return out
-
-
-def code_signals() -> dict[str, bool]:
-    from kfac_tpu.resilience import signals
-
-    return {name: spec.exits for name, spec in signals.HANDLED_SIGNALS.items()}
+DOC = drift.ROBUSTNESS_DOC
 
 
 def check(doc_path: str = DOC) -> list[str]:
     """Return human-readable drift complaints (empty = in sync)."""
-    documented = doc_signals(doc_path)
-    actual = code_signals()
-    problems = []
-    for name in sorted(set(actual) - set(documented)):
-        problems.append(f'handled signal not documented (add to {DOC}): {name}')
-    for name in sorted(set(documented) - set(actual)):
-        problems.append(f'documented signal has no handler in signals.py: {name}')
-    for name in sorted(set(actual) & set(documented)):
-        if actual[name] != documented[name]:
-            problems.append(
-                f'{name}: docs say '
-                f'{"exit" if documented[name] else "continue"} but '
-                f'HANDLED_SIGNALS.exits={actual[name]}'
-            )
-    return problems
+    return drift.check_signals(doc_path)
 
 
 def main() -> int:
-    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
-    # the repo is not pip-installed; make `python tools/...` work from root
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    if repo_root not in sys.path:
-        sys.path.insert(0, repo_root)
-    os.chdir(repo_root)
     problems = check()
     if problems:
         print('signal-semantics drift between code and docs:')
         for p in problems:
             print(f'  {p}')
         return 1
-    print(f'signal lint ok: {len(doc_signals(DOC))} documented signals '
-          'match resilience.signals.HANDLED_SIGNALS')
+    print(f'signal lint ok: {len(drift.doc_signals(DOC))} documented '
+          'signals match resilience.signals.HANDLED_SIGNALS')
     return 0
 
 

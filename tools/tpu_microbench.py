@@ -14,7 +14,12 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common
+
+# repo-root import only — no bootstrap(): this script must keep the real
+# TPU platform, not the CPU pin the lint/CLI scripts default to
+sys.path.insert(0, _common.repo_root())
 
 import jax
 import jax.numpy as jnp
