@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults async obs tune resilience lint inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults async compress obs tune resilience lint inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -44,17 +44,26 @@ async:
 	$(TEST_ENV) $(PY) -m pytest tests/test_async_inverse.py -q
 	$(TEST_ENV) $(PY) tools/lint_named_scopes.py
 
+# compressed curvature collectives + cold-factor host offload:
+# quantization/error-feedback/offload suite (bit-exactness, wire-ratio
+# and convergence-parity gates; see docs/ARCHITECTURE.md
+# "Compression & offload")
+compress:
+	$(TEST_ENV) $(PY) -m pytest tests/test_compression.py -q
+
 # telemetry spine: observability + flight-recorder test suites, the
-# unified static-analysis pass (which includes the named-scope,
-# metric-key and plan-schema lints as KFL101-KFL103), and the
+# compression/offload suite (its wire-bytes accounting is part of the
+# comms report contract), the unified static-analysis pass (which
+# includes the named-scope, metric-key, plan-schema and
+# compression-knob lints as KFL101-KFL103/KFL105), and the
 # kfac_inspect analysis selftest (see docs/OBSERVABILITY.md)
-obs: async lint
+obs: async lint compress
 	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py \
 		tests/test_flight_recorder.py -q
 	$(PY) tools/kfac_inspect.py --selftest
 
 # kfaclint: AST rules (KFL001-KFL005) + docs-vs-code drift rules
-# (KFL100-KFL104) + the analyzer's own fixture selftest and test suite
+# (KFL100-KFL105) + the analyzer's own fixture selftest and test suite
 # (see docs/ANALYSIS.md)
 lint:
 	$(TEST_ENV) $(PY) tools/kfaclint.py --all

@@ -355,6 +355,106 @@ def _async_spike_probe(d: int = 512, window: int = 8, windows: int = 3) -> dict:
     return out
 
 
+def _compression_probe(d: int = 256, steps: int = 24) -> dict:
+    """Compressed-transport + cold-factor-offload probe
+    (docs/ARCHITECTURE.md "Compression & offload").
+
+    A/B's the distributed bucketed engine on the same MLP at the f32 vs
+    int8 wire: reports the static wire-bytes ratio from
+    ``comms_report()`` (the >= 3x acceptance figure) next to eager
+    per-step medians for both wires. Then runs a short eager offload
+    Trainer loop (factor cadence 8, ``min_cold_steps=2``,
+    ``prefetch_lead=1``) and reports the live ``OffloadManager``
+    counters — ``prefetch_hit_rate`` 1.0 means every restore found its
+    host->device transfer already in flight.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import kfac_tpu
+    from kfac_tpu import training
+    from kfac_tpu.models import MLP
+    from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+
+    model = MLP(features=(d, d), num_classes=16)
+    x = jax.random.normal(jax.random.PRNGKey(6), (128, d))
+    y = jax.random.normal(jax.random.PRNGKey(7), (128, 16))
+    params = model.init(jax.random.PRNGKey(8), x)['params']
+    reg = kfac_tpu.register_model(model, x)
+
+    def loss(p, batch):
+        xx, yy = batch
+        return jnp.mean((model.apply({'params': p}, xx) - yy) ** 2)
+
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss)
+    mesh = kaisa_mesh(grad_worker_fraction=1.0)
+
+    def series(stat_compression):
+        cfg = kfac_tpu.KFACPreconditioner(
+            registry=reg, damping=1e-3, lr=0.1,
+            allreduce_method='allreduce_bucketed',
+            stat_compression=stat_compression,
+        )
+        eng = DistributedKFAC(config=cfg, mesh=mesh)
+
+        @jax.jit
+        def step(state, p, batch):
+            (l, _), grads, stats = run(p, batch)
+            return eng.step(state, grads, stats, loss=l)
+
+        state = eng.init()
+        state, pg = step(state, params, (x, y))  # compile — excluded
+        jax.block_until_ready(pg)
+        times = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            state, pg = step(state, params, (x, y))
+            jax.block_until_ready(pg)
+            times.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(times)), eng.comms_report()['stat_transport']
+
+    t_f32, st_f32 = series(None)
+    t_int8, st_int8 = series('int8')
+    out = {
+        'compression_probe_config': f'mlp_d{d}_b128_bucketed',
+        'wire_ratio_int8': round(
+            st_int8['raw_bytes'] / st_int8['wire_bytes'], 3),
+        'stat_wire_bytes_f32': st_f32['wire_bytes'],
+        'stat_wire_bytes_int8': st_int8['wire_bytes'],
+        'step_p50_ms_f32_wire': round(t_f32, 3),
+        'step_p50_ms_int8_wire': round(t_int8, 3),
+    }
+
+    # cold-factor offload: the eager Trainer loop is what drives the
+    # host-side pump, so the counters only move on this path
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg, damping=1e-3, lr=0.1,
+        factor_update_steps=8, inv_update_steps=8,
+        offload=kfac_tpu.OffloadConfig(min_cold_steps=2, prefetch_lead=1),
+    )
+
+    def loss3(p, model_state, batch):
+        return loss(p, batch), model_state
+
+    trainer = training.Trainer(
+        loss_fn=loss3, optimizer=optax.sgd(0.05), kfac=kfac
+    )
+    tstate = trainer.init(params)
+    last = None
+    for _ in range(steps):
+        tstate, last = trainer.step(tstate, (x, y))
+    jax.block_until_ready(last)
+    counters = dict(trainer.kfac._offload_manager.stats)
+    attempts = counters['prefetch_hits'] + counters['prefetch_misses']
+    counters['prefetch_hit_rate'] = (
+        round(counters['prefetch_hits'] / attempts, 3) if attempts else None
+    )
+    out['offload'] = counters
+    return out
+
+
 def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     """Observability probe: per-step metrics JSONL, metrics-on overhead vs
     a metrics-off loop timed back-to-back, and a phase-level step-time
@@ -464,6 +564,11 @@ def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     _log('  async refresh spike probe (sync vs sliced, d=512)')
     phases.update(_async_spike_probe())
     result['step_breakdown_ms'] = phases
+
+    # compressed-wire + offload probe, same guarded-by-caller contract
+    _atomic_write(out_path, result)
+    _log('  compression/offload probe (int8 vs f32 wire, cold factors)')
+    result['compression_probe'] = _compression_probe()
 
 
 # ---------------------------------------------------------------------------
@@ -927,6 +1032,9 @@ _HEADLINE_KEYS = (
     # observability-probe fields (docs/OBSERVABILITY.md)
     'metrics_jsonl', 'metrics_compilations', 'metrics_overhead_pct',
     'step_breakdown_ms', 'obs_probe_error',
+    # compressed-wire + cold-factor-offload probe (docs/ARCHITECTURE.md
+    # "Compression & offload")
+    'compression_probe',
     # active tuned layout plan, when KFAC_TUNE_PLAN is set (docs/AUTOTUNE.md)
     'tuned_plan',
 )

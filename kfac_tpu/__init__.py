@@ -15,6 +15,7 @@ from kfac_tpu import observability
 from kfac_tpu import resilience
 from kfac_tpu.autotune import TunedPlan
 from kfac_tpu.async_inverse import AsyncInverseConfig
+from kfac_tpu.compression import CompressionConfig, OffloadConfig
 from kfac_tpu.resilience import CheckpointManager, Preempted
 from kfac_tpu.health import HealthConfig, HealthState
 from kfac_tpu.observability import (
@@ -47,6 +48,7 @@ __all__ = [
     'AsyncInverseConfig',
     'CapturedStats',
     'CheckpointManager',
+    'CompressionConfig',
     'ComputeMethod',
     'CurvatureCapture',
     'DistributedStrategy',
@@ -57,6 +59,7 @@ __all__ = [
     'KFACState',
     'MetricsCollector',
     'MetricsConfig',
+    'OffloadConfig',
     'PostmortemWriter',
     'Preempted',
     'Registry',

@@ -1,4 +1,4 @@
-"""KFL100–KFL104: the migrated docs-vs-code drift linters.
+"""KFL100–KFL105: the migrated docs-vs-code drift linters.
 
 These are ``kind='project'`` rules — unlike the AST rules they import
 the live ``kfac_tpu`` modules and compare real objects (metric schemas,
@@ -29,6 +29,7 @@ ANALYSIS_DOC = 'docs/ANALYSIS.md'
 OBSERVABILITY_DOC = 'docs/OBSERVABILITY.md'
 AUTOTUNE_DOC = 'docs/AUTOTUNE.md'
 ROBUSTNESS_DOC = 'docs/ROBUSTNESS.md'
+ARCHITECTURE_DOC = 'docs/ARCHITECTURE.md'
 
 #: documented metric keys that are drain-record fields, not metric_keys
 #: entries (KFL102)
@@ -305,6 +306,49 @@ def _signals() -> list[core.Finding]:
     return _doc_findings('KFL104', ROBUSTNESS_DOC, line, check_signals())
 
 
+# -------------------------------------------------- KFL105 compression knobs
+
+
+def check_compression_knobs(doc_path: str = ARCHITECTURE_DOC) -> list[str]:
+    """Drift between the docs/ARCHITECTURE.md compression/offload knob
+    table and the ``CompressionConfig``/``OffloadConfig`` dataclass
+    fields — the knobs `stat_compression=` / `offload=` actually accept."""
+    import dataclasses
+
+    section, _ = doc_section(doc_path, '### Compression & offload knobs')
+    documented = table_first_cells(section)
+    from kfac_tpu.compression import config as compression_config_lib
+
+    actual = {
+        f.name
+        for cls in (
+            compression_config_lib.CompressionConfig,
+            compression_config_lib.OffloadConfig,
+        )
+        for f in dataclasses.fields(cls)
+    }
+    problems = []
+    for k in sorted(actual - documented):
+        problems.append(f'undocumented config field (add to {doc_path}): {k}')
+    for k in sorted(documented - actual):
+        problems.append(
+            f'documented knob is not a CompressionConfig/OffloadConfig '
+            f'field: {k}'
+        )
+    return problems
+
+
+def _compression_knobs() -> list[core.Finding]:
+    try:
+        _, line = doc_section(
+            ARCHITECTURE_DOC, '### Compression & offload knobs'
+        )
+        problems = check_compression_knobs()
+    except (OSError, ValueError) as exc:
+        return _doc_findings('KFL105', ARCHITECTURE_DOC, 1, [str(exc)])
+    return _doc_findings('KFL105', ARCHITECTURE_DOC, line, problems)
+
+
 # --------------------------------------------------------------- registration
 
 
@@ -364,5 +408,18 @@ core.register(core.Rule(
     why='cluster launch scripts send SIGTERM/SIGUSR1 expecting exactly '
         'the documented behavior; a flipped exits flag strands jobs',
     check=_signals,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL105',
+    name='compression-knobs-doc',
+    what='drift between the docs/ARCHITECTURE.md "Compression & offload '
+         'knobs" table and the CompressionConfig/OffloadConfig dataclass '
+         'fields',
+    why='the wire-quantization and offload knobs change numerics and '
+        'memory residency; an undocumented (or phantom) knob is how a '
+        'convergence regression gets configured by folklore',
+    check=_compression_knobs,
     kind='project',
 ))

@@ -42,11 +42,17 @@ KNOB_KEYS = (
     'inv_update_steps',
     'colocate_factors',
     'async_inverse',
+    'stat_compression',
+    'offload',
 )
 
 # Knobs added after schema-v1 plans shipped: absent in older documents,
 # filled with these defaults on load so old plans keep applying cleanly.
-OPTIONAL_KNOBS: dict[str, Any] = {'async_inverse': None}
+OPTIONAL_KNOBS: dict[str, Any] = {
+    'async_inverse': None,
+    'stat_compression': None,
+    'offload': False,
+}
 
 
 def plan_schema_keys() -> tuple[str, ...]:
@@ -200,6 +206,10 @@ def apply_knobs(config: Any, knobs: dict[str, Any]) -> Any:
         colocate_factors=bool(knobs['colocate_factors']),
         # normalized by the config's __post_init__ (mode string or None)
         async_inverse=knobs.get('async_inverse'),
+        # post-v1 knobs: dtype string / bool shorthands, normalized to
+        # CompressionConfig / OffloadConfig by the config's __post_init__
+        stat_compression=knobs.get('stat_compression'),
+        offload=knobs.get('offload', False) or None,
     )
 
 

@@ -20,10 +20,18 @@ into async refresh (``async_inverse=``). An async window amortizes the
 refresh off the critical path, so longer cadences stop costing latency
 spikes and become worth enumerating: the grid then widens to
 {c, 2c, 4c} and every candidate carries the base's async mode.
+
+Candidates inherit the base config's ``stat_compression`` (bucketed
+transports only — the quantizer rides the packed flat buffers) and
+``offload`` knobs. When NO candidate fits ``hardware.hbm_bytes``, the
+grid is retried once with cold-factor offload enabled — the HBM budget
+is a soft constraint when factor stacks can spill to host RAM — before
+the search gives up (recorded as ``meta['offload_fallback']``).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import statistics
 import time
 from typing import Any, Callable, Sequence
@@ -47,6 +55,20 @@ def _async_mode(base: Any) -> str | None:
     AsyncInverseConfig and a raw mode string)."""
     acfg = getattr(base, 'async_inverse', None)
     return getattr(acfg, 'mode', acfg)
+
+
+def _compression_dtype(base: Any) -> str | None:
+    """The base config's stat-compression wire dtype ('int8' | 'fp8') or
+    None (accepts both the normalized CompressionConfig and a raw dtype
+    string). Candidates carry it only on the bucketed transport — the
+    quantizer operates on the packed flat buffers."""
+    ccfg = getattr(base, 'stat_compression', None)
+    return getattr(ccfg, 'dtype', ccfg)
+
+
+def _offload_enabled(base: Any) -> bool:
+    """Whether the base config runs the cold-factor host offload."""
+    return getattr(base, 'offload', None) is not None
 
 
 def enumerate_candidates(
@@ -75,6 +97,8 @@ def enumerate_candidates(
         # explicit call, see the module docstring)
         inv_cadences = (c, 2 * c, 4 * c) if async_mode else (c,)
     factor_cadence = _static_cadence(base.factor_update_steps)
+    comp = _compression_dtype(base)
+    offload = _offload_enabled(base)
     out = []
     for frac in fractions:
         workers = assignment_lib.grad_worker_count(world, frac)
@@ -95,6 +119,10 @@ def enumerate_candidates(
                             else bool(base.colocate_factors)
                         ),
                         async_inverse=async_mode,
+                        stat_compression=(
+                            comp if method == 'ALLREDUCE_BUCKETED' else None
+                        ),
+                        offload=offload,
                     ))
     return out
 
@@ -139,6 +167,11 @@ def baseline_candidates(world: int, base: Any) -> list[model_lib.Candidate]:
                 else bool(base.colocate_factors)
             ),
             async_inverse=_async_mode(base),
+            stat_compression=(
+                _compression_dtype(base)
+                if method == 'ALLREDUCE_BUCKETED' else None
+            ),
+            offload=_offload_enabled(base),
         )
         for f in fracs
     ]
@@ -230,15 +263,35 @@ def autotune(
         world, base, fractions=fractions, granularities=granularities,
         transports=transports, inv_cadences=inv_cadences,
     )
-    for b in baseline_candidates(world, base):
+    baselines = baseline_candidates(world, base)
+    for b in baselines:
         if b not in cands:
             cands.append(b)
+
+    def _rank(rows):
+        order = sorted(
+            range(len(cands)),
+            key=lambda i: (
+                not rows[i]['feasible'], rows[i]['predicted_step_s'], i),
+        )
+        return order, [i for i in order if rows[i]['feasible']]
+
     rows = [model_lib.predict(c, base, world, hardware) for c in cands]
-    order = sorted(
-        range(len(cands)),
-        key=lambda i: (not rows[i]['feasible'], rows[i]['predicted_step_s'], i),
-    )
-    feasible = [i for i in order if rows[i]['feasible']]
+    order, feasible = _rank(rows)
+    offload_fallback = False
+    if not feasible:
+        # The HBM budget is a SOFT constraint once cold factors can spill
+        # to host RAM: retry the whole grid with offload on before giving
+        # up. No fallback exists under 'sliced' async refresh — it reads
+        # factor slices mid-window, so the stacks can never leave HBM.
+        if _async_mode(base) != 'sliced' and not all(c.offload for c in cands):
+            offload_fallback = True
+            cands = [dataclasses.replace(c, offload=True) for c in cands]
+            baselines = [
+                dataclasses.replace(b, offload=True) for b in baselines
+            ]
+            rows = [model_lib.predict(c, base, world, hardware) for c in cands]
+            order, feasible = _rank(rows)
     if not feasible:
         raise ValueError(
             'no candidate fits the HBM budget; raise hardware.hbm_bytes '
@@ -248,7 +301,7 @@ def autotune(
     do_measure = measure and loss_fn is not None
     trial_set = list(dict.fromkeys(
         feasible[:top_k] + [
-            i for i in (cands.index(b) for b in baseline_candidates(world, base))
+            i for i in (cands.index(b) for b in baselines)
             if rows[i]['feasible']
         ]
     ))
@@ -291,5 +344,6 @@ def autotune(
             'measured_candidates': len(trial_set) if do_measure else 0,
             'warmup': warmup,
             'iters': iters,
+            'offload_fallback': offload_fallback,
         },
     )

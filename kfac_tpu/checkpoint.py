@@ -134,13 +134,36 @@ def durable_state(state: Any) -> dict[str, Any]:
     dict state of :class:`kfac_tpu.parallel.PipelineKFAC`. The health
     counters are stored as a plain field dict of per-layer scalars —
     layout-independent, so they also survive cross-layout migration.
+
+    The compressed-transport error-feedback residuals (``comp_ef``) are
+    durable too: the residual is deferred factor mass, and dropping it at
+    a restore would bias the next EMA by exactly the noise error feedback
+    exists to cancel.
+
+    Raises on a state whose factors are cold-offload placeholders
+    (spilled to host RAM): persisting zero-size stubs would silently
+    write an unusable checkpoint. The Trainer's checkpoint driver hands
+    the manager's resident ``host_view`` here instead — this raise is the
+    backstop for direct ``save`` calls on a spilled state.
     """
     if isinstance(state, dict):
         return {'step': state['step'], 'a': state['a'], 'g': state['g']}
+    from kfac_tpu.compression import offload as offload_lib
+
+    if offload_lib.is_spilled(state):
+        raise ValueError(
+            'cannot checkpoint a spilled K-FAC state: the factor slots are '
+            'cold-offload placeholders (the real factors live in host RAM). '
+            'Use OffloadManager.host_view(state) for a resident view, or '
+            'let the Trainer checkpoint driver handle it.'
+        )
     out = {'step': state.step, 'a': state.a, 'g': state.g}
     health = getattr(state, 'health', None)
     if health is not None:
         out['health'] = health._asdict()
+    comp_ef = getattr(state, 'comp_ef', None)
+    if comp_ef is not None:
+        out['comp_ef'] = dict(comp_ef)
     return out
 
 
@@ -155,6 +178,8 @@ def _with_durable(state: Any, loaded: dict[str, Any]) -> Any:
     )
     if 'health' in loaded and getattr(state, 'health', None) is not None:
         state = state._replace(health=_health_from_saved(loaded['health']))
+    if 'comp_ef' in loaded and getattr(state, 'comp_ef', None) is not None:
+        state = state._replace(comp_ef=dict(loaded['comp_ef']))
     return state
 
 
@@ -439,50 +464,60 @@ def _retry_health_mismatch(
     engine: Any,
     exc: Exception,
 ) -> dict[str, Any]:
-    """Structure-mismatch fallback: tolerate health-presence drift.
+    """Structure-mismatch fallback: tolerate config-presence drift.
 
     A checkpoint written without health counters must restore into a
     health-enabled engine (counters start fresh), and one written WITH
     them must restore into a health-disabled engine (counters dropped) —
     toggling the sentinel between runs is configuration, not a layout
-    change. Anything else re-raises the layout diagnosis."""
+    change. Likewise a pre-compression checkpoint (no ``comp_ef``) must
+    restore into an error-feedback engine: the residual starts from
+    init()'s zeros. (The opposite comp_ef direction — an EF checkpoint
+    into an EF-less engine — has no template to offer orbax and falls
+    through to the layout diagnosis, which names ``stat_compression``.)
+    Anything else re-raises the layout diagnosis."""
     kfac_t = template['kfac']
-    retried = None
+    health_toggled = None
     if 'health' in kfac_t:
-        retried = {
-            **template,
-            'kfac': {k: v for k, v in kfac_t.items() if k != 'health'},
+        health_toggled = {
+            k: v for k, v in kfac_t.items() if k != 'health'
         }
     else:
         reg = getattr(engine, 'registry', None)
         if reg is not None and not isinstance(template_state, dict):
             from kfac_tpu import health as health_lib
 
-            retried = {
-                **template,
-                'kfac': {
-                    **kfac_t,
-                    'health': health_lib.init_health(
-                        reg.names()
-                    )._asdict(),
-                },
+            health_toggled = {
+                **kfac_t,
+                'health': health_lib.init_health(reg.names())._asdict(),
             }
-    if retried is not None:
+    variants = []
+    if health_toggled is not None:
+        variants.append(health_toggled)
+    # toggle comp_ef independently and jointly with the health toggle
+    for base in (kfac_t, health_toggled):
+        if base is not None and 'comp_ef' in base:
+            variants.append(
+                {k: v for k, v in base.items() if k != 'comp_ef'}
+            )
+    for kf in variants:
         try:
-            payload = ckptr.restore(path, target=retried)
+            payload = ckptr.restore(path, target={**template, 'kfac': kf})
         except (ValueError, KeyError):
-            payload = None
-        if payload is not None:
-            # either direction resolves to "no health in the loaded
-            # payload": a sentinel-less checkpoint keeps init()'s fresh
-            # counters; a sentinel-less engine drops the saved ones
-            payload['kfac'].pop('health', None)
-            return payload
+            continue
+        # either health direction resolves to "no health in the loaded
+        # payload": a sentinel-less checkpoint keeps init()'s fresh
+        # counters; a sentinel-less engine drops the saved ones. A
+        # comp_ef-less payload keeps init()'s zero residuals.
+        payload['kfac'].pop('health', None)
+        return payload
     raise ValueError(
         f'checkpoint at {path!r} does not match the engine state '
         'layout. For DistributedKFAC the stacked bucket keys/shapes '
         'depend on the config (notably bucket_granularity and '
-        'colocate_factors): restore with the SAME values the '
+        'colocate_factors), and error-feedback residuals saved under '
+        'stat_compression need a compression-enabled engine (or the same '
+        'chunking) to restore into: restore with the SAME values the '
         'checkpoint was saved under — or write checkpoints with '
         'save(..., engine=engine) so restore can diagnose and migrate '
         f'layout changes. Original error: {exc}'

@@ -1,0 +1,44 @@
+"""Compressed curvature collectives + cold-factor host offload.
+
+Two independent levers extending the KAISA memory<->communication trade
+(docs/ARCHITECTURE.md "Compression & offload"):
+
+- **Low-precision stat transport** (:mod:`kfac_tpu.compression.quant`):
+  int8/fp8 blockwise-scaled quantization of the triu-packed factor
+  allreduce payloads on the ``ALLREDUCE_BUCKETED`` path, with a
+  per-chunk error-feedback residual carried as durable engine state so
+  the quantization noise stays zero-mean in the factor EMA (the
+  1-bit-Adam / PowerSGD compressed-second-moment line of work).
+- **Cold-factor host offload** (:mod:`kfac_tpu.compression.offload`):
+  spill the factor stacks to host RAM between factor/inverse cadence
+  boundaries and prefetch them back ahead of the next boundary, so HBM
+  holds only the hot decomposition state on interior steps.
+"""
+
+from kfac_tpu.compression.config import (
+    CompressionConfig,
+    OffloadConfig,
+    as_compression_config,
+    as_offload_config,
+)
+from kfac_tpu.compression.offload import OffloadManager, is_spilled, pump
+from kfac_tpu.compression.quant import (
+    dequantize_blockwise,
+    error_bound,
+    quantize_blockwise,
+    wire_bytes,
+)
+
+__all__ = [
+    'CompressionConfig',
+    'OffloadConfig',
+    'OffloadManager',
+    'as_compression_config',
+    'as_offload_config',
+    'dequantize_blockwise',
+    'error_bound',
+    'is_spilled',
+    'pump',
+    'quantize_blockwise',
+    'wire_bytes',
+]

@@ -1,0 +1,129 @@
+"""Configuration for compressed stat transport and cold-factor offload.
+
+Both knobs surface on :class:`kfac_tpu.KFACPreconditioner` (and through
+it on ``DistributedKFAC``) with the same normalizer idiom as
+``async_inverse``: ``None``/``False`` disables, ``True`` selects
+defaults, a shorthand scalar configures the headline knob, or pass the
+config dataclass directly. The knob tables in docs/ARCHITECTURE.md are
+pinned to these dataclass fields by lint rule KFL105.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+#: supported transport quantization dtypes: 'int8' (symmetric round-to-
+#: nearest at scale amax/127) and 'fp8' (float8_e4m3fn cast at scale
+#: amax/448)
+QUANT_DTYPES = ('int8', 'fp8')
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionConfig:
+    """Knobs for the low-precision stat transport.
+
+    Args:
+        dtype: wire dtype of the quantized triangle payload — ``'int8'``
+            or ``'fp8'`` (float8_e4m3fn; requires a JAX build with fp8
+            dtypes).
+        block_size: elements per scaling block. Each block of the packed
+            flat buffer carries one float32 amax-derived scale, so the
+            wire overhead is ``4 / block_size`` bytes per element and the
+            quantization error bound is per-block, not per-buffer.
+        error_feedback: carry the per-chunk quantization residual across
+            factor updates as durable engine state (``comp_ef``) and add
+            it back before the next quantization, so compression noise
+            averages out of the factor EMA instead of biasing it.
+    """
+
+    dtype: str = 'int8'
+    block_size: int = 256
+    error_feedback: bool = True
+
+    def __post_init__(self) -> None:
+        if self.dtype not in QUANT_DTYPES:
+            raise ValueError(
+                f'unknown compression dtype {self.dtype!r}; expected one '
+                f'of {QUANT_DTYPES}'
+            )
+        if self.dtype == 'fp8' and not hasattr(jnp, 'float8_e4m3fn'):
+            raise ValueError(
+                "stat_compression dtype 'fp8' requires a JAX build with "
+                "float8_e4m3fn; use dtype='int8' on this installation"
+            )
+        if self.block_size < 1:
+            raise ValueError(
+                f'block_size must be >= 1, got {self.block_size}'
+            )
+
+
+def as_compression_config(value: Any) -> CompressionConfig | None:
+    """Normalize the ``stat_compression=`` constructor surface.
+
+    Accepts ``None``/``False`` (disabled), ``True`` (int8 defaults), a
+    dtype string (``'int8'``/``'fp8'``), or a
+    :class:`CompressionConfig`.
+    """
+    if value is None or value is False:
+        return None
+    if value is True:
+        return CompressionConfig()
+    if isinstance(value, str):
+        return CompressionConfig(dtype=value)
+    if isinstance(value, CompressionConfig):
+        return value
+    raise TypeError(
+        'stat_compression must be a CompressionConfig, a dtype string '
+        f'({QUANT_DTYPES}), True, False, or None; got {value!r}'
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class OffloadConfig:
+    """Knobs for the cold-factor host offload.
+
+    Args:
+        min_cold_steps: spill the factor stacks to host RAM only when
+            the next factor/inverse cadence boundary is at least this
+            many steps away — shorter gaps aren't worth the round trip.
+        prefetch_lead: start the asynchronous ``device_put`` of the
+            spilled stacks this many steps BEFORE the boundary that
+            consumes them, so the boundary step finds them resident
+            (a prefetch hit) instead of blocking on the transfer.
+    """
+
+    min_cold_steps: int = 4
+    prefetch_lead: int = 1
+
+    def __post_init__(self) -> None:
+        if self.min_cold_steps < 1:
+            raise ValueError(
+                f'min_cold_steps must be >= 1, got {self.min_cold_steps}'
+            )
+        if self.prefetch_lead < 0:
+            raise ValueError(
+                f'prefetch_lead must be >= 0, got {self.prefetch_lead}'
+            )
+
+
+def as_offload_config(value: Any) -> OffloadConfig | None:
+    """Normalize the ``offload=`` constructor surface.
+
+    Accepts ``None``/``False`` (disabled), ``True`` (defaults), an int
+    (``min_cold_steps`` shorthand), or an :class:`OffloadConfig`.
+    """
+    if value is None or value is False:
+        return None
+    if value is True:
+        return OffloadConfig()
+    if isinstance(value, int) and not isinstance(value, bool):
+        return OffloadConfig(min_cold_steps=value)
+    if isinstance(value, OffloadConfig):
+        return value
+    raise TypeError(
+        'offload must be an OffloadConfig, an int min_cold_steps, True, '
+        f'False, or None; got {value!r}'
+    )

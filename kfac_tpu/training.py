@@ -26,6 +26,7 @@ import optax
 from kfac_tpu import health as health_lib
 from kfac_tpu import tracing
 from kfac_tpu.async_inverse import host as async_host_lib
+from kfac_tpu.compression import offload as offload_lib
 from kfac_tpu.layers import capture as capture_lib
 
 
@@ -306,6 +307,29 @@ class Trainer:
             return state
         return state._replace(kfac_state=ks)
 
+    def _drive_offload(
+        self, state: TrainState, step: int | None
+    ) -> TrainState:
+        """Tick the cold-factor offload state machine (``offload`` config;
+        no-op otherwise) — spill/prefetch/restore decisions are host-side,
+        see :func:`kfac_tpu.compression.offload.pump`.
+
+        With ``step``: full spill/prefetch/restore cadence logic. Without
+        one (the scan paths, where the host cannot intervene mid-scan):
+        restores residency and leaves the factors resident for the whole
+        scan.
+        """
+        if (
+            self.kfac is None
+            or state.kfac_state is None
+            or getattr(self.kfac, '_offload_manager', None) is None
+        ):
+            return state
+        ks = offload_lib.pump(self.kfac, state.kfac_state, step=step)
+        if ks is state.kfac_state:
+            return state
+        return state._replace(kfac_state=ks)
+
     def _drive_checkpoints(self, state: TrainState) -> None:
         """Tick the checkpoint autopilot after a completed step.
 
@@ -314,9 +338,21 @@ class Trainer:
         the device counter itself. A :class:`kfac_tpu.resilience
         .Preempted` raised here propagates out of the step call — by
         then the emergency checkpoint is already durable.
+
+        If a save lands while the factor state is spilled (cold-factor
+        offload), the manager is handed a RESIDENT view assembled from
+        the offload manager's host copies — zero device traffic, and the
+        checkpoint never contains offload placeholders.
         """
-        if self.checkpoints is not None:
-            self.checkpoints.on_step(state, step=self._step_count)
+        if self.checkpoints is None:
+            return
+        mgr = getattr(self.kfac, '_offload_manager', None)
+        view = state
+        if mgr is not None and mgr.spilled and state.kfac_state is not None:
+            view = state._replace(
+                kfac_state=mgr.host_view(state.kfac_state)
+            )
+        self.checkpoints.on_step(view, step=self._step_count)
 
     def restore_latest(
         self, params: Any, model_state: Any = None
@@ -367,6 +403,7 @@ class Trainer:
         """
         self._sync_step_count(state)
         state = self._drive_async(state, self._step_count)
+        state = self._drive_offload(state, self._step_count)
         with jax.profiler.StepTraceAnnotation(
             'train', step_num=self._step_count
         ):
@@ -472,6 +509,7 @@ class Trainer:
         Returns (final_state, per-step losses).
         """
         state = self._drive_async(state, None)
+        state = self._drive_offload(state, None)
         if not hasattr(self, '_jit_scan'):
             donate = (0,) if self.donate_state else ()
             executed = (
@@ -596,6 +634,7 @@ class Trainer:
         )
         loss = acc['loss'] / n
         state = self._drive_async(state, self._step_count)
+        state = self._drive_offload(state, self._step_count)
         new_state = self._jit_apply_kfac(
             state,
             grads_avg,
@@ -653,6 +692,7 @@ class Trainer:
             )
         self._sync_step_count(state)
         state = self._drive_async(state, self._step_count)
+        state = self._drive_offload(state, self._step_count)
         capture_now = self._capture_now()
         if not hasattr(self, '_jit_accum_scan'):
             executed = self._executed_layers(

@@ -1,0 +1,412 @@
+"""Compressed curvature collectives + cold-factor offload suite.
+
+Covers the contracts of docs/ARCHITECTURE.md "Compression & offload":
+quantization round-trip bounds, the >= 3x wire-ratio acceptance on the
+bucketed transport, error-feedback durability across checkpoints,
+bit-exactness of the offload round trip, knob validation, and the
+autotuner integration (plan backward compat, HBM soft-constraint
+fallback, model<->engine byte parity).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import kfac_tpu
+from kfac_tpu import checkpoint, training
+from kfac_tpu.autotune import model as model_lib
+from kfac_tpu.autotune import plan as plan_lib
+from kfac_tpu.autotune import search as search_lib
+from kfac_tpu.compression import (
+    CompressionConfig,
+    OffloadConfig,
+    dequantize_blockwise,
+    error_bound,
+    quantize_blockwise,
+    wire_bytes,
+)
+from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+from testing import models
+
+WORLD = 8
+
+_HAS_FP8 = hasattr(jnp, 'float8_e4m3fn')
+_DTYPES = ('int8', 'fp8') if _HAS_FP8 else ('int8',)
+
+
+# ------------------------------------------------------------- quantization
+
+
+@pytest.mark.parametrize('dtype', _DTYPES)
+@pytest.mark.parametrize('block_size', [32, 256])
+@pytest.mark.parametrize('n', [7, 256, 1000])
+def test_quant_round_trip_within_bound(dtype, block_size, n):
+    x = jax.random.normal(jax.random.PRNGKey(n), (n,)) * 3.0
+    payload, scales = quantize_blockwise(x, dtype, block_size)
+    assert payload.shape == (n,)
+    deq = dequantize_blockwise(payload, scales, n, block_size)
+    err = np.asarray(jnp.abs(deq - x))
+    xb = np.asarray(x)
+    for b in range(scales.shape[0]):
+        blk = slice(b * block_size, min((b + 1) * block_size, n))
+        amax = float(np.max(np.abs(xb[blk]))) if xb[blk].size else 0.0
+        assert float(err[blk].max(initial=0.0)) <= error_bound(amax, dtype)
+
+
+@pytest.mark.parametrize('dtype', _DTYPES)
+def test_quant_all_zero_block_is_exact(dtype):
+    x = jnp.zeros((300,))
+    payload, scales = quantize_blockwise(x, dtype, 256)
+    deq = dequantize_blockwise(payload, scales, 300, 256)
+    np.testing.assert_array_equal(np.asarray(deq), 0.0)
+
+
+def test_wire_bytes_trimmed_payload():
+    # 119 elements in 256-wide blocks: 1 block, payload trimmed to 119
+    wb = wire_bytes(119, 'int8', 256)
+    assert wb == {
+        'payload_bytes': 119, 'scale_bytes': 4, 'wire_bytes': 123}
+    # ratio vs an f32 raw buffer clears 3x even on this tiny chunk
+    assert 119 * 4 / wb['wire_bytes'] > 3.0
+
+
+# ------------------------------------------------------------- config knobs
+
+
+def _setup(frac=1.0, **cfg_kw):
+    mesh = kaisa_mesh(grad_worker_fraction=frac)
+    m = models.TinyModel(hidden=8, out=4)
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=WORLD * 8, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    cfg = kfac_tpu.KFACPreconditioner(registry=reg, damping=1e-3, **cfg_kw)
+    dk = DistributedKFAC(config=cfg, mesh=mesh)
+    return m, params, (x, y), reg, cfg, dk, models.mse_loss(m)
+
+
+def _reg():
+    m = models.TinyModel(hidden=8, out=4)
+    x, _ = models.regression_data(jax.random.PRNGKey(1), n=64, dim=6)
+    return kfac_tpu.register_model(m, x)
+
+
+def test_compression_requires_bucketed_transport():
+    with pytest.raises(ValueError, match='allreduce_bucketed'):
+        kfac_tpu.KFACPreconditioner(
+            registry=_reg(), stat_compression='int8')
+
+
+def test_offload_rejects_sliced_async_and_callable_cadence():
+    with pytest.raises(ValueError, match='sliced'):
+        kfac_tpu.KFACPreconditioner(
+            registry=_reg(), offload=True, async_inverse='sliced',
+            inv_update_steps=4)
+    with pytest.raises(ValueError, match='callable|schedule'):
+        kfac_tpu.KFACPreconditioner(
+            registry=_reg(), offload=True,
+            factor_update_steps=lambda s: 8)
+
+
+def test_config_shorthands():
+    cfg = kfac_tpu.KFACPreconditioner(
+        registry=_reg(), allreduce_method='allreduce_bucketed',
+        stat_compression=True, offload=2)
+    assert cfg.stat_compression == CompressionConfig()
+    assert cfg.offload == OffloadConfig(min_cold_steps=2)
+    off = kfac_tpu.KFACPreconditioner(
+        registry=_reg(), stat_compression=None, offload=False)
+    assert off.stat_compression is None and off.offload is None
+
+
+# --------------------------------------------------- compressed stat transport
+
+
+def _one_step(dk, params, batch, loss_fn):
+    run = kfac_tpu.CurvatureCapture(dk.config.registry).value_stats_and_grad(
+        loss_fn)
+
+    @jax.jit
+    def step(state, p, b):
+        (l, _), grads, stats = run(p, b)
+        return dk.step(state, grads, stats, loss=l)
+
+    state, pg = step(dk.init(), params, batch)
+    return state, pg
+
+
+def test_compression_off_wire_equals_raw_and_no_ef_state():
+    _, params, batch, _, _, dk, loss_fn = _setup(
+        allreduce_method='allreduce_bucketed')
+    st = dk.comms_report()['stat_transport']
+    assert st['wire_bytes'] == st['raw_bytes'] == st['bytes']
+    assert st['compression'] is None
+    state, _ = _one_step(dk, params, batch, loss_fn)
+    assert state.comp_ef is None
+
+
+def test_compressed_step_close_to_fp32_and_ef_carried():
+    _, params, batch, _, _, dk32, loss_fn = _setup(
+        allreduce_method='allreduce_bucketed')
+    _, _, _, _, _, dk8, _ = _setup(
+        allreduce_method='allreduce_bucketed', stat_compression='int8')
+    _, pg32 = _one_step(dk32, params, batch, loss_fn)
+    state8, pg8 = _one_step(dk8, params, batch, loss_fn)
+    for a, b in zip(jax.tree_util.tree_leaves(pg32),
+                    jax.tree_util.tree_leaves(pg8)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-3, rtol=2e-2)
+    # the error-feedback residual is real state: present, f32, nonzero
+    assert state8.comp_ef is not None
+    total = sum(
+        float(jnp.abs(v).sum()) for v in state8.comp_ef.values())
+    assert total > 0.0
+
+
+def test_wire_ratio_clears_3x():
+    _, _, _, _, _, dk8, _ = _setup(
+        allreduce_method='allreduce_bucketed', stat_compression='int8')
+    st = dk8.comms_report()['stat_transport']
+    assert st['compression']['ratio'] >= 3.0
+    assert st['wire_bytes'] * 3 <= st['raw_bytes']
+    assert st['bytes'] == st['wire_bytes']
+
+
+def test_comp_ef_checkpoint_round_trip(tmp_path):
+    _, params, batch, _, _, dk8, loss_fn = _setup(
+        allreduce_method='allreduce_bucketed', stat_compression='int8')
+    state, _ = _one_step(dk8, params, batch, loss_fn)
+    path = str(tmp_path / 'ckpt')
+    checkpoint.save(path, state, engine=dk8)
+    restored, _ = checkpoint.restore(path, dk8)
+    assert restored.comp_ef is not None
+    for k, v in state.comp_ef.items():
+        np.testing.assert_array_equal(
+            np.asarray(v), np.asarray(restored.comp_ef[k]))
+
+
+def test_pre_compression_checkpoint_restores_with_zero_ef(tmp_path):
+    # a checkpoint saved by a compression-less engine restores into a
+    # compressed engine with the EF residual reset to zeros
+    _, params, batch, _, _, dk32, loss_fn = _setup(
+        allreduce_method='allreduce_bucketed')
+    state32, _ = _one_step(dk32, params, batch, loss_fn)
+    path = str(tmp_path / 'ckpt_old')
+    checkpoint.save(path, state32, engine=dk32)
+    _, _, _, _, _, dk8, _ = _setup(
+        allreduce_method='allreduce_bucketed', stat_compression='int8')
+    restored, _ = checkpoint.restore(path, dk8)
+    assert restored.comp_ef is not None
+    total = sum(float(jnp.abs(v).sum()) for v in restored.comp_ef.values())
+    assert total == 0.0
+
+
+def test_ef_checkpoint_into_efless_engine_raises(tmp_path):
+    _, params, batch, _, _, dk8, loss_fn = _setup(
+        allreduce_method='allreduce_bucketed', stat_compression='int8')
+    state8, _ = _one_step(dk8, params, batch, loss_fn)
+    path = str(tmp_path / 'ckpt_ef')
+    checkpoint.save(path, state8, engine=dk8)
+    _, _, _, _, _, dk32, _ = _setup(
+        allreduce_method='allreduce_bucketed')
+    with pytest.raises(ValueError, match='stat_compression'):
+        checkpoint.restore(path, dk32)
+
+
+# ----------------------------------------------------------- offload trainer
+
+
+def _trainer_losses(offload, steps=17):
+    m = models.TinyModel(hidden=8, out=4)
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=64, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg, damping=1e-3, lr=0.1,
+        factor_update_steps=8, inv_update_steps=8, offload=offload)
+
+    def loss_fn(p, model_state, batch):
+        return models.mse_loss(m)(p, batch), model_state
+
+    import optax
+
+    trainer = training.Trainer(
+        loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=kfac)
+    state = trainer.init(params)
+    losses = []
+    for _ in range(steps):
+        state, l = trainer.step(state, (x, y))
+        losses.append(np.asarray(l))
+    return trainer, state, losses
+
+
+def test_offload_bit_identical_and_counters_move():
+    _, state_off, base = _trainer_losses(offload=None)
+    trainer, state_on, spilled = _trainer_losses(
+        offload=OffloadConfig(min_cold_steps=2, prefetch_lead=1))
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(spilled))
+    stats = trainer.kfac._offload_manager.stats
+    assert stats['spills'] > 0 and stats['restores'] > 0
+    assert stats['prefetch_hits'] > 0 and stats['prefetch_misses'] == 0
+    assert stats['bytes_to_host'] == stats['bytes_to_device'] > 0
+    # the factor EMAs themselves round-tripped exactly
+    for a, b in zip(jax.tree_util.tree_leaves(state_off.kfac_state.a),
+                    jax.tree_util.tree_leaves(state_on.kfac_state.a)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_spilled_state_cannot_be_checkpointed_directly():
+    from kfac_tpu.compression import offload as offload_lib
+
+    _, params, batch, _, _, dk, loss_fn = _setup(
+        allreduce_method='allreduce_bucketed',
+        factor_update_steps=8, inv_update_steps=8, offload=2)
+    state, _ = _one_step(dk, params, batch, loss_fn)
+    mgr = dk._offload_manager
+    # step 3 with f=c=8: next use is step 8, 5 cold steps away -> spill
+    spilled = offload_lib.pump(dk, state, step=3)
+    assert offload_lib.is_spilled(spilled)
+    with pytest.raises(ValueError, match='spilled'):
+        checkpoint.durable_state(spilled)
+    # host_view substitutes the host copies so a saver can still read it
+    view = mgr.host_view(spilled)
+    assert not offload_lib.is_spilled(view)
+    mgr.reset()
+
+
+def test_offload_comms_report_merges_live_counters():
+    _, params, batch, _, _, dk, loss_fn = _setup(
+        allreduce_method='allreduce_bucketed', offload=2)
+    rep = dk.comms_report()['offload']
+    assert rep['min_cold_steps'] == 2 and rep['prefetch_lead'] == 1
+    assert rep['spill_bytes'] > 0
+    assert rep['spills'] == 0 and rep['prefetch_hits'] == 0
+    # no-offload engines report None
+    _, _, _, _, _, dk_plain, _ = _setup(
+        allreduce_method='allreduce_bucketed')
+    assert dk_plain.comms_report()['offload'] is None
+
+
+# ----------------------------------------------------------------- autotune
+
+
+def _base(**kw):
+    return kfac_tpu.KFACPreconditioner(registry=_reg(), **kw)
+
+
+def test_plan_round_trip_and_pre_pr8_compat(tmp_path):
+    import json
+
+    base = _base(allreduce_method='allreduce_bucketed',
+                 stat_compression='int8')
+    p = search_lib.autotune(base, world=WORLD, measure=False)
+    assert 'stat_compression' in p.knobs and 'offload' in p.knobs
+    path = str(tmp_path / 'plan.json')
+    p.save(path)
+    assert plan_lib.TunedPlan.load(path).knobs == p.knobs
+    # a pre-compression plan document (no new knobs) still loads, with
+    # the optional knobs defaulted
+    doc = json.loads(json.dumps(p.to_json()))
+    for k in ('stat_compression', 'offload'):
+        doc['knobs'].pop(k)
+    old = plan_lib.TunedPlan.from_json(doc)
+    assert old.knobs['stat_compression'] is None
+    assert old.knobs['offload'] is False
+    cfg = plan_lib.apply_knobs(base, old.knobs)
+    assert cfg.stat_compression is None and cfg.offload is None
+
+
+def test_autotune_offload_fallback_when_hbm_too_small():
+    base = _base(allreduce_method='allreduce_bucketed')
+    cands = search_lib.enumerate_candidates(WORLD, base)
+    hw = model_lib.HardwareSpec()
+    resident = min(
+        model_lib.predict(c, base, WORLD, hw)[
+            'memory_per_device_bytes']['total']
+        for c in cands)
+    spilled = min(
+        model_lib.predict(
+            dataclasses.replace(c, offload=True), base, WORLD, hw)[
+            'memory_per_device_bytes']['total']
+        for c in cands)
+    assert spilled < resident
+    budget = (resident + spilled) / 2
+    plan = search_lib.autotune(
+        base, world=WORLD, measure=False,
+        hardware=model_lib.HardwareSpec(hbm_bytes=budget))
+    assert plan.meta['offload_fallback'] is True
+    assert plan.knobs['offload'] is True
+    row = next(r for r in plan.cost_table if r['feasible'])
+    assert row['memory_per_device_bytes']['factors'] == 0.0
+    assert row['memory_per_device_bytes']['factors_offloaded'] > 0.0
+    assert row['offload_transfer_s'] > 0.0
+    # no fallback exists under sliced async refresh
+    sliced = _base(async_inverse='sliced', inv_update_steps=4)
+    with pytest.raises(ValueError, match='HBM'):
+        search_lib.autotune(
+            sliced, world=WORLD, measure=False,
+            hardware=model_lib.HardwareSpec(hbm_bytes=budget))
+
+
+def test_predict_prices_wire_bytes_with_engine_parity():
+    base = _base(allreduce_method='allreduce_bucketed')
+    cand = model_lib.Candidate(
+        grad_worker_fraction=1.0, bucket_granularity=1,
+        allreduce_method='ALLREDUCE_BUCKETED', allreduce_bucket_cap_mb=25.0,
+        stat_compression='int8')
+    row = model_lib.predict(cand, base, WORLD)
+    cfg = model_lib.candidate_config(base, cand)
+    eng = DistributedKFAC(
+        config=cfg, mesh=kaisa_mesh(grad_worker_fraction=1.0))
+    st = eng.comms_report()['stat_transport']
+    assert row['bytes_per_occurrence']['stat_transport'] == st['bytes']
+    assert st['bytes'] == st['wire_bytes'] < st['raw_bytes']
+    # the uncompressed candidate prices strictly more stat bytes
+    dense = model_lib.predict(
+        dataclasses.replace(cand, stat_compression=None), base, WORLD)
+    assert (row['bytes_per_occurrence']['stat_transport']
+            < dense['bytes_per_occurrence']['stat_transport'])
+
+
+# -------------------------------------------------------- convergence parity
+
+
+@pytest.mark.slow
+def test_int8_error_feedback_convergence_parity():
+    """int8+EF training tracks the f32 wire to a close final loss."""
+    m = models.TinyModel(hidden=8, out=4)
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=64, dim=6)
+    params0 = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    loss_fn = models.mse_loss(m)
+
+    def train(stat_compression, steps=40):
+        cfg = kfac_tpu.KFACPreconditioner(
+            registry=reg, damping=1e-3, lr=0.1,
+            allreduce_method='allreduce_bucketed',
+            factor_update_steps=2, inv_update_steps=2,
+            stat_compression=stat_compression)
+        dk = DistributedKFAC(
+            config=cfg, mesh=kaisa_mesh(grad_worker_fraction=1.0))
+        run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
+
+        @jax.jit
+        def step(state, p, b):
+            (l, _), grads, stats = run(p, b)
+            state, pg = dk.step(state, grads, stats, loss=l)
+            p = jax.tree_util.tree_map(lambda w, g: w - 0.1 * g, p, pg)
+            return state, p, l
+
+        state, p = dk.init(), params0
+        l = None
+        for _ in range(steps):
+            state, p, l = step(state, p, (x, y))
+        return float(l)
+
+    l32 = train(None)
+    l8 = train('int8')
+    assert np.isfinite(l8)
+    # parity: the compressed run lands within 5% of the f32 final loss
+    assert abs(l8 - l32) <= 0.05 * max(abs(l32), 1e-8)
