@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults async compress obs tune resilience lint inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults async compress obs tune resilience lint lint-ir inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -55,20 +55,29 @@ compress:
 # compression/offload suite (its wire-bytes accounting is part of the
 # comms report contract), the unified static-analysis pass (which
 # includes the named-scope, metric-key, plan-schema and
-# compression-knob lints as KFL101-KFL103/KFL105), and the
-# kfac_inspect analysis selftest (see docs/OBSERVABILITY.md)
+# compression-knob lints as KFL101-KFL103/KFL105 plus the IR-tier
+# smoke pass via lint-ir), and the kfac_inspect analysis selftest
+# (see docs/OBSERVABILITY.md)
 obs: async lint compress
 	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py \
 		tests/test_flight_recorder.py -q
 	$(PY) tools/kfac_inspect.py --selftest
 
+# kfaclint IR tier alone (KFL201-KFL205), smoke profile: traces only
+# the dense-transport d=64 eigen config so wall-clock stays bounded;
+# the full strategy x method x transport matrix runs behind the `slow`
+# marker in tests/test_kfaclint_ir.py (see docs/ANALYSIS.md "IR tier")
+lint-ir:
+	$(TEST_ENV) $(PY) tools/kfaclint.py --ir --smoke
+
 # kfaclint: AST rules (KFL001-KFL005) + docs-vs-code drift rules
-# (KFL100-KFL105) + the analyzer's own fixture selftest and test suite
-# (see docs/ANALYSIS.md)
-lint:
-	$(TEST_ENV) $(PY) tools/kfaclint.py --all
+# (KFL100-KFL105) + IR rules (KFL201-KFL205, smoke profile) + the
+# analyzer's own fixture selftest and test suites (see docs/ANALYSIS.md)
+lint: lint-ir
+	$(TEST_ENV) $(PY) tools/kfaclint.py --all --smoke
 	$(TEST_ENV) $(PY) tools/kfaclint.py --selftest
-	$(TEST_ENV) $(PY) -m pytest tests/test_kfaclint.py -q
+	$(TEST_ENV) $(PY) -m pytest tests/test_kfaclint.py \
+		tests/test_kfaclint_ir.py -q -m 'not slow'
 
 # layout autotuner: test suite, the plan-schema doc lint, and the
 # end-to-end kfac_tune pipeline selftest (see docs/AUTOTUNE.md)

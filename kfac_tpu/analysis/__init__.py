@@ -1,16 +1,19 @@
-"""kfaclint: AST-based JAX/SPMD correctness analysis for this repo.
+"""kfaclint: AST + IR JAX/SPMD correctness analysis for this repo.
 
 See docs/ANALYSIS.md for the rule table and suppression syntax; the CLI
 lives at ``tools/kfaclint.py``. Importing this package populates the
 rule registry (the rule modules register on import).
 
 The AST rules (KFL001–KFL005) need only the stdlib; the drift rules
-(KFL100–KFL104) import live ``kfac_tpu`` modules at *check* time, not at
-import time, so ``from kfac_tpu import analysis`` stays cheap.
+(KFL100–KFL105) import live ``kfac_tpu`` modules at *check* time, and
+the IR rules (KFL201–KFL205, ``analysis/ir/``) trace the engines at
+*check* time — not at import time, so ``from kfac_tpu import analysis``
+stays cheap.
 """
 
 from kfac_tpu.analysis import (  # noqa: F401  (imported for registration)
     drift,
+    ir,
     rules_jit,
     rules_pytree,
     rules_spmd,
@@ -25,6 +28,7 @@ from kfac_tpu.analysis.core import (  # noqa: F401
     load_baseline,
     load_project,
     register,
+    remap_baseline,
     render_json,
     render_text,
     save_baseline,
@@ -32,4 +36,7 @@ from kfac_tpu.analysis.core import (  # noqa: F401
 )
 
 AST_RULE_CODES = ('KFL001', 'KFL002', 'KFL003', 'KFL004', 'KFL005')
-PROJECT_RULE_CODES = ('KFL100', 'KFL101', 'KFL102', 'KFL103', 'KFL104')
+PROJECT_RULE_CODES = (
+    'KFL100', 'KFL101', 'KFL102', 'KFL103', 'KFL104', 'KFL105',
+)
+IR_RULE_CODES = ('KFL201', 'KFL202', 'KFL203', 'KFL204', 'KFL205')

@@ -12,7 +12,13 @@ host-side dispatch and is deliberately NOT an entry) or a ``jax.jit`` /
   modules;
 - function names passed as *arguments* to calls — this is what carries
   reachability through ``jax.lax.cond(pred, launch, noop, x)`` without
-  special-casing every ``lax`` combinator.
+  special-casing every ``lax`` combinator;
+- lambdas and ``functools.partial`` wrappers: a lambda argument (direct
+  or inside ``partial(...)``) becomes its own graph node, assignments
+  like ``step = partial(jax.jit, ...)(lambda g: ...)`` or
+  ``step = jit(fn)`` mark the wrapped body as an entry, and
+  ``_jitted = partial(jax.jit, ...)`` used as ``@_jitted`` counts as an
+  entry decorator.
 
 Functions handed to ``io_callback`` / ``pure_callback`` / ``debug.callback``
 run on the HOST by construction, so those argument edges are dropped —
@@ -42,14 +48,18 @@ _ENTRY_DECORATORS = frozenset({'scope', 'jit'})
 
 @dataclasses.dataclass
 class FuncInfo:
-    """One function/method definition in the analyzed tree."""
+    """One function/method/lambda definition in the analyzed tree."""
 
-    node: ast.FunctionDef | ast.AsyncFunctionDef
+    node: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
     module: core.SourceModule
     qualname: str  # 'f', 'Cls.m', 'f.<locals>.g'
     cls: str | None
     parent: 'FuncInfo | None'
     locals_: dict[str, 'FuncInfo'] = dataclasses.field(default_factory=dict)
+    #: set when the definition is wrapped by jit at assignment time, e.g.
+    #: ``step = partial(jax.jit, ...)(lambda g: ...)`` — no decorator list
+    #: exists, but the body still runs inside jit
+    forced_entry: bool = False
 
     @property
     def display(self) -> str:
@@ -79,11 +89,26 @@ class CallGraph:
         self.methods: dict[str, dict[str, dict[str, FuncInfo]]] = {}
         #: per module: alias -> dotted import target
         self.imports: dict[str, dict[str, str]] = {}
+        #: per module: names bound to jit-like decorator factories, e.g.
+        #: ``_jitted = partial(jax.jit, donate_argnums=(0,))``
+        self.entry_aliases: dict[str, set[str]] = {}
+        #: jit applications whose wrapped target is a *name* that may be
+        #: defined later in the file: resolved in a post-pass
+        self._deferred_entries: list[
+            tuple[core.SourceModule, FuncInfo | None, str | None, ast.AST]
+        ] = []
+        #: ``name = partial(f, ...)`` aliases, resolved in a post-pass
+        self._deferred_partials: list[
+            tuple[core.SourceModule, FuncInfo | None, str | None, str,
+                  str, ast.AST]
+        ] = []
         for mod in project.modules:
             self.imports[mod.modname] = core.import_map(mod.tree)
             self.methods[mod.modname] = {}
+            self.entry_aliases[mod.modname] = set()
             self._index_body(mod, mod.tree.body, qual='', cls=None,
                              parent=None)
+        self._resolve_deferred()
 
     # ------------------------------------------------------------- indexing
 
@@ -115,55 +140,166 @@ class CallGraph:
                           if isinstance(n, ast.stmt)],
                     qual=qual, cls=cls, parent=parent,
                 )
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                self._index_assign(mod, node, qual, cls, parent)
+
+    def _index_assign(self, mod, node, qual, cls, parent) -> None:
+        """Index function values bound by assignment.
+
+        Covers the blind spots from PR 7: ``f = lambda ...``,
+        ``step = jit(fn)`` / ``step = partial(jax.jit, ...)(lambda ...)``
+        (the body runs inside jit with no decorator list), and
+        ``g = partial(f, ...)`` / ``_jitted = partial(jax.jit, ...)``
+        aliases used later as callees or decorators.
+        """
+        targets = node.targets if isinstance(node, ast.Assign) else (
+            [node.target]
+        )
+        if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+            return
+        name, value = targets[0].id, node.value
+        if value is None:
+            return
+        if isinstance(value, ast.Lambda):
+            self._index_function_value(mod, name, value, qual, cls, parent)
+            return
+        if not isinstance(value, ast.Call):
+            # bare alias: ``_j = jax.jit`` makes ``@_j`` an entry decorator
+            if _decorator_is_entry(value):
+                self.entry_aliases[mod.modname].add(name)
+            return
+        # application: ``jit(X)`` / ``partial(jax.jit, ...)(X)``
+        if _decorator_is_entry(value.func) and value.args:
+            wrapped = value.args[0]
+            if isinstance(wrapped, ast.Lambda):
+                self._index_function_value(
+                    mod, name, wrapped, qual, cls, parent, forced=True
+                )
+            elif isinstance(wrapped, (ast.Name, ast.Attribute)):
+                self._deferred_entries.append((mod, parent, cls, wrapped))
+            return
+        # factory: ``_jitted = partial(jax.jit, ...)`` (decorator alias)
+        if _decorator_is_entry(value):
+            self.entry_aliases[mod.modname].add(name)
+            return
+        # plain alias: ``g = partial(f, ...)`` forwards calls to ``f``
+        if core.call_name(value.func) == 'partial' and value.args:
+            self._deferred_partials.append(
+                (mod, parent, cls, qual, name, value.args[0])
+            )
+
+    def _index_function_value(
+        self, mod, name, fn_node, qual, cls, parent, forced=False
+    ) -> None:
+        qualname = f'{qual}{name}'
+        info = FuncInfo(fn_node, mod, qualname, cls, parent,
+                        forced_entry=forced)
+        self.functions[(mod.modname, qualname)] = info
+        if cls is not None and parent is None:
+            self.methods[mod.modname].setdefault(cls, {})[name] = info
+        if parent is not None:
+            parent.locals_[name] = info
+
+    def _resolve_deferred(self) -> None:
+        for mod, parent, cls, node in self._deferred_entries:
+            hit = self._resolve_in_scope(mod, parent, cls, node)
+            if hit is not None:
+                hit.forced_entry = True
+        for mod, parent, cls, qual, name, node in self._deferred_partials:
+            hit = self._resolve_in_scope(mod, parent, cls, node)
+            if hit is None:
+                continue
+            # register the alias name so later calls/args resolve to the
+            # wrapped function (same FuncInfo, no copy)
+            self.functions.setdefault((mod.modname, f'{qual}{name}'), hit)
+            if parent is not None:
+                parent.locals_.setdefault(name, hit)
+            elif cls is not None:
+                self.methods[mod.modname].setdefault(cls, {}).setdefault(
+                    name, hit
+                )
 
     # ------------------------------------------------------------ resolving
 
+    def _is_entry(self, info: FuncInfo) -> bool:
+        if info.forced_entry:
+            return True
+        aliases = self.entry_aliases.get(info.module.modname, ())
+        for dec in getattr(info.node, 'decorator_list', ()):
+            if _decorator_is_entry(dec):
+                return True
+            # ``@_jitted`` where ``_jitted = partial(jax.jit, ...)``
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if isinstance(target, ast.Name) and target.id in aliases:
+                return True
+        return False
+
     def entries(self) -> list[FuncInfo]:
         return [
-            info for info in self.functions.values()
-            if any(_decorator_is_entry(d)
-                   for d in info.node.decorator_list)
+            info for info in self.functions.values() if self._is_entry(info)
         ]
 
-    def _resolve_name(self, info: FuncInfo, name: str) -> FuncInfo | None:
-        # nested defs of the enclosing function chain win (Python scoping)
-        scope: FuncInfo | None = info
-        while scope is not None:
-            if name in scope.locals_:
-                return scope.locals_[name]
-            scope = scope.parent
-        mod = info.module.modname
-        hit = self.functions.get((mod, name))
-        if hit is not None:
-            return hit
-        target = self.imports.get(mod, {}).get(name)
-        if target and '.' in target:
-            tmod, _, attr = target.rpartition('.')
-            return self.functions.get((tmod, attr))
-        return None
-
-    def _resolve_attr(
-        self, info: FuncInfo, node: ast.Attribute
+    def _resolve_in_scope(
+        self, mod: core.SourceModule, parent: FuncInfo | None,
+        cls: str | None, node: ast.AST,
     ) -> FuncInfo | None:
-        base = node.value
-        if isinstance(base, ast.Name):
-            if base.id == 'self' and info.cls is not None:
-                return self.methods.get(info.module.modname, {}).get(
-                    info.cls, {}
-                ).get(node.attr)
-            target = self.imports.get(info.module.modname, {}).get(base.id)
-            if target:
-                return self.functions.get((target, node.attr))
+        if isinstance(node, ast.Name):
+            name = node.id
+            # nested defs of the enclosing function chain win (Python
+            # scoping)
+            scope: FuncInfo | None = parent
+            while scope is not None:
+                if name in scope.locals_:
+                    return scope.locals_[name]
+                scope = scope.parent
+            hit = self.functions.get((mod.modname, name))
+            if hit is not None:
+                return hit
+            target = self.imports.get(mod.modname, {}).get(name)
+            if target and '.' in target:
+                tmod, _, attr = target.rpartition('.')
+                return self.functions.get((tmod, attr))
+            return None
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name):
+                if base.id == 'self' and cls is not None:
+                    return self.methods.get(mod.modname, {}).get(
+                        cls, {}
+                    ).get(node.attr)
+                target = self.imports.get(mod.modname, {}).get(base.id)
+                if target:
+                    return self.functions.get((target, node.attr))
         return None
 
     def resolve(self, info: FuncInfo, node: ast.AST) -> FuncInfo | None:
-        if isinstance(node, ast.Name):
-            return self._resolve_name(info, node.id)
-        if isinstance(node, ast.Attribute):
-            return self._resolve_attr(info, node)
-        return None
+        return self._resolve_in_scope(info.module, info, info.cls, node)
 
     # --------------------------------------------------------- reachability
+
+    def _lambda_info(self, info: FuncInfo, lam: ast.Lambda) -> FuncInfo:
+        return FuncInfo(lam, info.module, f'{info.qualname}.<lambda>',
+                        info.cls, info)
+
+    def _arg_edges(
+        self, info: FuncInfo, arg: ast.AST
+    ) -> Iterator[FuncInfo]:
+        """Reachability carried by a function-valued call argument."""
+        if isinstance(arg, (ast.Name, ast.Attribute)):
+            hit = self.resolve(info, arg)
+            if hit is not None:
+                yield hit
+        elif isinstance(arg, ast.Lambda):
+            # walk_skipping_functions skips lambda bodies, so a lambda
+            # handed to e.g. lax.cond must become its own graph node
+            yield self._lambda_info(info, arg)
+        elif isinstance(arg, ast.Call) and (
+            core.call_name(arg.func) == 'partial'
+        ):
+            # ``lax.cond(p, partial(launch, cfg), partial(noop), x)`` —
+            # the partial's own target and args carry reachability too
+            for inner in list(arg.args) + [kw.value for kw in arg.keywords]:
+                yield from self._arg_edges(info, inner)
 
     def _edges(self, info: FuncInfo) -> Iterator[FuncInfo]:
         for node in core.walk_skipping_functions(info.node):
@@ -175,10 +311,7 @@ class CallGraph:
             if core.call_name(node.func) in HOST_CALLBACK_FUNCS:
                 continue  # function args run on the host
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
-                if isinstance(arg, (ast.Name, ast.Attribute)):
-                    hit = self.resolve(info, arg)
-                    if hit is not None:
-                        yield hit
+                yield from self._arg_edges(info, arg)
 
     def reachable_from_entries(self) -> dict[int, tuple[FuncInfo, str]]:
         """{id(fn node): (FuncInfo, entry display name that reaches it)}."""

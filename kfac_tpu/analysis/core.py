@@ -1,6 +1,6 @@
 """kfaclint framework core: findings, suppressions, registry, baseline.
 
-The analyzer is deliberately two-layered:
+The analyzer is deliberately layered:
 
 - **AST rules** (``kind='ast'``) parse the target tree with ``ast`` only —
   no imports of the analyzed code, so a rule can never be broken by an
@@ -10,8 +10,12 @@ The analyzer is deliberately two-layered:
   (``tools/lint_*``): they import ``kfac_tpu`` and compare live objects
   (metric schemas, signal tables, plan schemas, scope markers) against
   the checked-in docs.
+- **IR rules** (``kind='ir'``, ``analysis/ir/``) trace the registered
+  engine entry points to jaxprs on abstract inputs and check the lowered
+  program itself: dtype drift, collective axes, sharding contracts,
+  callbacks on the step path, and cost-model parity.
 
-Both kinds produce :class:`Finding` records that flow through one
+All kinds produce :class:`Finding` records that flow through one
 suppression / baseline / reporting pipeline, so ``tools/kfaclint.py
 --all`` is the single lint entry point for the repo.
 
@@ -71,8 +75,48 @@ class Suppression:
     comment_line: int
 
 
+def _statement_spans(tree: ast.Module) -> list[tuple[int, int]]:
+    """(lineno, end_lineno) of every statement, innermost included."""
+    spans: list[tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.stmt):
+            spans.append(
+                (node.lineno, getattr(node, 'end_lineno', None) or node.lineno)
+            )
+    return spans
+
+
+def _covered_lines(
+    comment_line: int, standalone: bool, spans: Sequence[tuple[int, int]]
+) -> tuple[int, ...]:
+    """Lines a suppression at ``comment_line`` covers.
+
+    Suppressions anchor to *logical statements*, not physical lines: a
+    trailing comment covers the innermost statement containing its line
+    (so a directive on any continuation line of a wrapped call covers the
+    whole call), and a standalone comment covers the next statement in
+    full. Falls back to the historical physical-line behavior when no
+    statement matches (comments trailing decorators, end-of-file).
+    """
+    if standalone:
+        following = [s for s in spans if s[0] > comment_line]
+        if following:
+            first = min(s[0] for s in following)
+            span = min(
+                (s for s in following if s[0] == first),
+                key=lambda s: s[1] - s[0],
+            )
+            return tuple(range(comment_line, span[1] + 1))
+        return (comment_line, comment_line + 1)
+    containing = [s for s in spans if s[0] <= comment_line <= s[1]]
+    if containing:
+        span = min(containing, key=lambda s: s[1] - s[0])
+        return tuple(range(span[0], span[1] + 1))
+    return (comment_line,)
+
+
 def _parse_suppressions(
-    text: str, lines: Sequence[str]
+    text: str, lines: Sequence[str], tree: ast.Module | None = None
 ) -> tuple[list[Suppression], list[tuple[int, str]]]:
     # tokenize (rather than per-line regex) so that 'kfaclint:' inside a
     # string or docstring — e.g. this analyzer's own source — is never
@@ -83,6 +127,7 @@ def _parse_suppressions(
         tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
     except (tokenize.TokenError, IndentationError, SyntaxError):
         return sups, errors  # the parse-error finding covers this file
+    spans = _statement_spans(tree) if tree is not None else []
     for tok in tokens:
         if tok.type != tokenize.COMMENT:
             continue
@@ -107,7 +152,7 @@ def _parse_suppressions(
             reason = reason.strip() or None
         line = lines[i - 1] if i <= len(lines) else ''
         standalone = not line[: tok.start[1]].strip()
-        covered = (i, i + 1) if standalone else (i,)
+        covered = _covered_lines(i, standalone, spans)
         if reason is None:
             errors.append((
                 i,
@@ -130,7 +175,7 @@ class SourceModule:
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=path)
         self.suppressions, self.suppression_errors = _parse_suppressions(
-            text, self.lines
+            text, self.lines, self.tree
         )
 
     def suppressed(self, finding: Finding) -> bool:
@@ -352,6 +397,32 @@ def save_baseline(path: str, findings: Sequence[Finding]) -> None:
         f.write('\n')
 
 
+def remap_baseline(
+    baseline: Sequence[dict[str, str]], renames: dict[str, str]
+) -> list[dict[str, str]]:
+    """Rewrite baseline entry paths under ``renames`` (old -> new).
+
+    Baseline identity is ``(code, path, message)``, so a ``git mv`` breaks
+    every baselined finding in the moved file. ``--baseline-remap old:new``
+    applies this at load time; an exact-path match rewrites the entry, and
+    an ``old`` ending in ``/`` rewrites a whole directory prefix.
+    """
+    out: list[dict[str, str]] = []
+    for entry in baseline:
+        entry = dict(entry)
+        path = entry.get('path', '')
+        for old, new in renames.items():
+            if path == old:
+                path = new
+                break
+            if old.endswith('/') and path.startswith(old):
+                path = new.rstrip('/') + '/' + path[len(old):]
+                break
+        entry['path'] = path
+        out.append(entry)
+    return out
+
+
 def split_baseline(
     findings: Sequence[Finding], baseline: Sequence[dict[str, str]]
 ) -> tuple[list[Finding], int]:
@@ -490,7 +561,9 @@ def walk_skipping_functions(node: ast.AST) -> Iterable[ast.AST]:
         stack.extend(ast.iter_child_nodes(cur))
 
 
-def func_params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+def func_params(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda,
+) -> list[str]:
     a = fn.args
     names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
     if a.vararg:

@@ -1,0 +1,24 @@
+"""IR analysis tier: KFL2xx rules over traced engine entry points.
+
+Where the AST tier (``analysis/core.py`` + ``rules_*.py``) reads source
+text, this tier traces the registered engine entry points to ClosedJaxprs
+on abstract inputs and checks the *lowered program*: dtype dataflow,
+collective axis names, sharding contracts, step-path callbacks, and
+byte/FLOP parity with the autotuner cost model. See docs/ANALYSIS.md
+"IR tier".
+"""
+
+from kfac_tpu.analysis.ir import rules  # noqa: F401  (registers KFL201-205)
+from kfac_tpu.analysis.ir import harness, visitor
+from kfac_tpu.analysis.ir.harness import (  # noqa: F401
+    EngineTrace,
+    Suite,
+    active_profile,
+    build,
+    set_profile,
+)
+
+__all__ = [
+    'EngineTrace', 'Suite', 'active_profile', 'build', 'harness', 'rules',
+    'set_profile', 'visitor',
+]

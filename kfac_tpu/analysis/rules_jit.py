@@ -43,7 +43,9 @@ def _involves_traced_param(node: ast.AST, params: set[str]) -> bool:
     return False
 
 
-def _traced_params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+def _traced_params(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda,
+) -> set[str]:
     return {
         p for p in core.func_params(fn) if p not in _STATIC_PARAM_NAMES
     }

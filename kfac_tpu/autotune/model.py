@@ -195,6 +195,17 @@ def _decomp_flops(layout: StaticLayout) -> float:
     ))
 
 
+def decomp_flops(layout: StaticLayout) -> float:
+    """Public decomposition-FLOP pricing, verified against the lowered IR.
+
+    The KFL205 lint (kfac_tpu/analysis/ir) counts eigh/Newton–Schulz
+    FLOPs straight out of the traced update_inverses jaxpr and diffs them
+    against this number — keep the constants above in sync with the real
+    decomposition kernels or the lint will say so.
+    """
+    return _decomp_flops(layout)
+
+
 def _refresh_units(layout: StaticLayout) -> int:
     """How many independently refreshable decomposition units the layout
     has — the upper bound on the sliced backend's slice count (mirrors

@@ -302,6 +302,14 @@ class DistributedKFAC:
             :class:`~kfac_tpu.warnings.LayoutPlanWarning`.
     """
 
+    # Entry points the IR analyzer (kfac_tpu/analysis/ir) traces to
+    # jaxprs; IR_STEP_PATH marks the per-step critical path (KFL204).
+    # Unannotated on purpose: class constants, not dataclass fields.
+    IR_ENTRY_POINTS = (
+        'update_factors', 'update_inverses', 'precondition', 'step',
+    )
+    IR_STEP_PATH = ('step',)
+
     config: KFACPreconditioner
     mesh: Any = None
     auto_layout: Any = None

@@ -152,6 +152,15 @@ class KFACPreconditioner:
     hyperparameters from an instance of this class.
     """
 
+    # Entry points the IR analyzer (kfac_tpu/analysis/ir) traces to
+    # jaxprs; IR_STEP_PATH marks the ones on the per-step critical path
+    # (KFL204 callback policing). Unannotated on purpose: class
+    # constants, not dataclass fields.
+    IR_ENTRY_POINTS = (
+        'update_factors', 'update_inverses', 'precondition', 'step',
+    )
+    IR_STEP_PATH = ('step',)
+
     registry: registry_lib.Registry
     factor_update_steps: int | Callable[[jax.Array], jax.Array] = 1
     inv_update_steps: int | Callable[[jax.Array], jax.Array] = 1

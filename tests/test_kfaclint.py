@@ -525,3 +525,240 @@ def test_repo_is_clean_under_ast_rules():
         parse_errors=errors,
     )
     assert findings == [], [f.render() for f in findings]
+
+
+# --------------------------------------------- statement-anchored suppression
+
+
+def test_trailing_suppression_on_continuation_line_covers_statement(tmp_path):
+    # the directive trails a *continuation* line of a wrapped call; the
+    # finding anchors to the call's first line — statement anchoring must
+    # cover the whole logical statement, not just the physical line
+    findings = run_snippet(tmp_path, '''
+        import jax
+
+        @jax.jit
+        def step(state, grads):
+            y = combine(
+                state,
+                grads.item(),  # kfaclint: disable=KFL001 (regression: wrapped call)
+            )
+            return y
+    ''', codes=['KFL001'])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_trailing_suppression_on_first_line_covers_continuations(tmp_path):
+    # directive on the opening line, sync on a later line of the same call
+    findings = run_snippet(tmp_path, '''
+        import jax
+
+        @jax.jit
+        def step(state, grads):
+            y = combine(  # kfaclint: disable=KFL001 (regression: wrapped call)
+                state,
+                grads.item(),
+            )
+            return y
+    ''', codes=['KFL001'])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_standalone_suppression_covers_whole_next_statement(tmp_path):
+    findings = run_snippet(tmp_path, '''
+        import jax
+
+        @jax.jit
+        def step(state, grads):
+            # kfaclint: disable=KFL001 (regression: multi-line statement)
+            y = combine(
+                state,
+                grads.item(),
+            )
+            return y
+    ''', codes=['KFL001'])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_suppression_does_not_leak_past_its_statement(tmp_path):
+    # the statement range must not swallow findings in the NEXT statement
+    findings = run_snippet(tmp_path, '''
+        import jax
+
+        @jax.jit
+        def step(state, grads):
+            y = combine(
+                state,  # kfaclint: disable=KFL001 (covers only this call)
+            )
+            return float(grads)
+    ''', codes=['KFL001'])
+    assert [f.code for f in findings] == ['KFL001']
+    assert 'float()' in findings[0].message
+
+
+# ------------------------------------- callgraph: lambdas, partial, aliases
+
+
+def test_kfl001_host_sync_behind_partial_jit_of_lambda(tmp_path):
+    # the PR-7 blind spot named in ISSUE 9: a host sync hidden behind
+    # partial(jit, ...) applied to a lambda — no decorator list anywhere
+    findings = run_snippet(tmp_path, '''
+        from functools import partial
+        import jax
+
+        step = partial(jax.jit, static_argnums=())(lambda g: float(g))
+    ''', codes=['KFL001'])
+    assert [f.code for f in findings] == ['KFL001']
+    assert 'float()' in findings[0].message
+
+
+def test_kfl001_through_jit_applied_to_named_function(tmp_path):
+    findings = run_snippet(tmp_path, '''
+        import jax
+
+        def refresh(state):
+            return state.metrics.item()
+
+        refresh_jit = jax.jit(refresh)
+    ''', codes=['KFL001'])
+    assert [f.code for f in findings] == ['KFL001']
+    assert '.item()' in findings[0].message
+
+
+def test_kfl001_through_decorator_alias(tmp_path):
+    findings = run_snippet(tmp_path, '''
+        from functools import partial
+        import jax
+
+        _jitted = partial(jax.jit, donate_argnums=(0,))
+
+        @_jitted
+        def step(state):
+            return float(state)
+    ''', codes=['KFL001'])
+    assert [f.code for f in findings] == ['KFL001']
+
+
+def test_kfl001_lambda_argument_to_lax_cond(tmp_path):
+    findings = run_snippet(tmp_path, '''
+        import jax
+
+        @jax.jit
+        def outer(x):
+            return jax.lax.cond(x > 0, lambda v: bool(v), lambda v: False, x)
+    ''', codes=['KFL001'])
+    assert [f.code for f in findings] == ['KFL001']
+    assert 'bool()' in findings[0].message
+
+
+def test_kfl001_partial_wrapped_callee_argument(tmp_path):
+    # reachability must flow through partial(...) handed to a combinator
+    findings = run_snippet(tmp_path, '''
+        import jax
+        from functools import partial
+
+        def launch(cfg, x):
+            return x.item()
+
+        @jax.jit
+        def outer(x):
+            return jax.lax.cond(x > 0, partial(launch, None), lambda v: v, x)
+    ''', codes=['KFL001'])
+    assert [f.code for f in findings] == ['KFL001']
+    assert '.item()' in findings[0].message
+
+
+def test_kfl001_partial_alias_forwards_to_wrapped_function(tmp_path):
+    findings = run_snippet(tmp_path, '''
+        import jax
+        from functools import partial
+
+        def drain(cfg, x):
+            return float(x)
+
+        drain_now = partial(drain, None)
+
+        @jax.jit
+        def outer(x):
+            return drain_now(x)
+    ''', codes=['KFL001'])
+    assert [f.code for f in findings] == ['KFL001']
+
+
+def test_lambda_behind_host_callback_still_not_flagged(tmp_path):
+    # host-callback argument edges stay dropped even for lambdas
+    findings = run_snippet(tmp_path, '''
+        import jax
+        from jax.experimental import io_callback
+
+        @jax.jit
+        def outer(x):
+            io_callback(lambda v: float(v), None, x, ordered=True)
+            return x
+    ''', codes=['KFL001'])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_plain_lambda_assignment_is_not_an_entry(tmp_path):
+    # a lambda never wrapped in jit is host-side code
+    findings = run_snippet(tmp_path, '''
+        to_python = lambda g: float(g)
+    ''', codes=['KFL001'])
+    assert findings == [], [f.render() for f in findings]
+
+
+# ------------------------------------------------------------ baseline remap
+
+
+def test_remap_baseline_exact_path():
+    base = [{'code': 'KFL001', 'path': 'old/a.py', 'message': 'm'}]
+    out = analysis.remap_baseline(base, {'old/a.py': 'new/b.py'})
+    assert out[0]['path'] == 'new/b.py'
+    # non-matching entries pass through untouched
+    out = analysis.remap_baseline(base, {'other.py': 'x.py'})
+    assert out[0]['path'] == 'old/a.py'
+
+
+def test_remap_baseline_directory_prefix():
+    base = [
+        {'code': 'KFL001', 'path': 'old/sub/a.py', 'message': 'm'},
+        {'code': 'KFL002', 'path': 'oldish/a.py', 'message': 'm'},
+    ]
+    out = analysis.remap_baseline(base, {'old/': 'new/'})
+    assert out[0]['path'] == 'new/sub/a.py'
+    assert out[1]['path'] == 'oldish/a.py'  # prefix match is on path parts
+
+
+def test_cli_baseline_remap_survives_git_mv(tmp_path, monkeypatch):
+    import sys
+
+    tools_dir = os.path.join(drift.REPO_ROOT, 'tools')
+    monkeypatch.syspath_prepend(tools_dir)
+    import kfaclint
+
+    src = textwrap.dedent('''
+        import jax
+
+        @jax.jit
+        def step(grads):
+            return float(grads)
+    ''')
+    old = tmp_path / 'old_name.py'
+    old.write_text(src)
+    bpath = tmp_path / 'baseline.json'
+    assert kfaclint.main([
+        '--update-baseline', '--baseline', str(bpath), str(old),
+    ]) == 0
+    # simulate git mv: same content, new path — baseline keys go stale
+    new = tmp_path / 'new_name.py'
+    old.rename(new)
+    assert kfaclint.main(['--baseline', str(bpath), str(new)]) == 1
+    old_rel = os.path.relpath(str(old), drift.REPO_ROOT).replace(os.sep, '/')
+    new_rel = os.path.relpath(str(new), drift.REPO_ROOT).replace(os.sep, '/')
+    assert kfaclint.main([
+        '--baseline', str(bpath),
+        '--baseline-remap', f'{old_rel}:{new_rel}', str(new),
+    ]) == 0
+    assert kfaclint.main([
+        '--baseline', str(bpath), '--baseline-remap', 'notapath', str(new),
+    ]) == 2

@@ -3,12 +3,18 @@
 
 Runs the AST rules (KFL001–KFL005: host-sync-in-jit, rank-divergent
 I/O, ephemeral-pytree drift, recompile hazards, callback discipline)
-over ``kfac_tpu/``, and with ``--all`` also the docs-vs-code drift rules
-(KFL100–KFL104) that the four ``tools/lint_*.py`` wrappers delegate to.
-See docs/ANALYSIS.md for the rule table and suppression syntax.
+over ``kfac_tpu/``; with ``--ir`` the jaxpr-level IR rules
+(KFL201–KFL205: dtype drift, collective axes, sharding contracts,
+step-path callbacks, cost-model parity — these trace the real engines,
+so they want the 8-device CPU env the Makefile sets); and with ``--all``
+everything, including the docs-vs-code drift rules (KFL100–KFL105) that
+the four ``tools/lint_*.py`` wrappers delegate to. See docs/ANALYSIS.md
+for the rule table and suppression syntax.
 
     JAX_PLATFORMS=cpu python tools/kfaclint.py --all        # CI entry
+    python tools/kfaclint.py --ir --smoke                   # fast IR tier
     python tools/kfaclint.py --rules KFL002 kfac_tpu/checkpoint.py
+    python tools/kfaclint.py --baseline-remap old.py:new.py --all
     python tools/kfaclint.py --list-rules
     python tools/kfaclint.py --selftest
 
@@ -225,11 +231,24 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument('targets', nargs='*',
                         help='files/dirs to analyze (default: kfac_tpu/)')
     parser.add_argument('--all', action='store_true',
-                        help='also run the project drift rules '
-                             '(KFL100-KFL104: docs-vs-code)')
+                        help='run every registered rule: AST, project '
+                             'drift (KFL100-KFL105) and IR (KFL201-KFL205)')
+    parser.add_argument('--ir', action='store_true',
+                        help='run the IR rules (KFL201-KFL205): trace '
+                             'engine entry points to jaxprs and check the '
+                             'lowered program')
+    parser.add_argument('--smoke', action='store_true',
+                        help='with --ir/--all: trace only the dense d=64 '
+                             'eigen config (bounded wall-clock; the full '
+                             'matrix lives behind the slow test marker)')
     parser.add_argument('--rules',
                         help='comma-separated rule codes to run '
                              '(default: all AST rules)')
+    parser.add_argument('--baseline-remap', action='append', default=[],
+                        metavar='OLD:NEW',
+                        help='rewrite baseline paths OLD->NEW before '
+                             'matching (repeatable; OLD ending in / '
+                             'remaps a directory prefix) — for git mv')
     parser.add_argument('--json', action='store_true',
                         help='emit the report as JSON instead of text')
     parser.add_argument('--baseline', default=BASELINE_DEFAULT,
@@ -256,11 +275,18 @@ def main(argv: list[str] | None = None) -> int:
             print(f'        {rule.what}')
         return 0
 
+    if args.smoke or args.ir or args.all:
+        from kfac_tpu.analysis import ir as ir_lib
+
+        ir_lib.set_profile('smoke' if args.smoke else 'default')
+
     try:
         if args.rules:
             rules = analysis.get_rules(args.rules.split(','))
         elif args.all:
             rules = analysis.all_rules()
+        elif args.ir:
+            rules = analysis.get_rules(analysis.IR_RULE_CODES)
         else:
             rules = analysis.get_rules(analysis.AST_RULE_CODES)
     except KeyError as exc:
@@ -278,6 +304,16 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     baseline = analysis.load_baseline(args.baseline)
+    if args.baseline_remap:
+        renames = {}
+        for item in args.baseline_remap:
+            old, sep, new = item.partition(':')
+            if not sep or not old or not new:
+                print(f'--baseline-remap wants OLD:NEW, got {item!r}',
+                      file=sys.stderr)
+                return 2
+            renames[old] = new
+        baseline = analysis.remap_baseline(baseline, renames)
     new, matched = analysis.split_baseline(findings, baseline)
     render = analysis.render_json if args.json else analysis.render_text
     print(render(new, baselined=matched, checked=len(project.modules)))
