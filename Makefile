@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults async compress obs tune resilience lint lint-ir inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults async compress fleet obs tune resilience lint lint-ir inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -51,14 +51,21 @@ async:
 compress:
 	$(TEST_ENV) $(PY) -m pytest tests/test_compression.py -q
 
+# self-driving fleet: retune-on-restore + drift-triggered live layout
+# migration suite (see docs/ROBUSTNESS.md "Self-driving fleet")
+fleet:
+	$(TEST_ENV) $(PY) -m pytest tests/test_fleet.py -q
+
 # telemetry spine: observability + flight-recorder test suites, the
 # compression/offload suite (its wire-bytes accounting is part of the
-# comms report contract), the unified static-analysis pass (which
-# includes the named-scope, metric-key, plan-schema and
-# compression-knob lints as KFL101-KFL103/KFL105 plus the IR-tier
-# smoke pass via lint-ir), and the kfac_inspect analysis selftest
+# comms report contract), the self-driving fleet suite (its drift
+# detector consumes the flight recorder's skew columns), the unified
+# static-analysis pass (which includes the named-scope, metric-key,
+# plan-schema, compression-knob and fleet-knob lints as
+# KFL101-KFL103/KFL105/KFL106 plus the IR-tier smoke pass via
+# lint-ir), and the kfac_inspect analysis selftest
 # (see docs/OBSERVABILITY.md)
-obs: async lint compress
+obs: async lint compress fleet
 	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py \
 		tests/test_flight_recorder.py -q
 	$(PY) tools/kfac_inspect.py --selftest

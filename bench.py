@@ -455,6 +455,107 @@ def _compression_probe(d: int = 256, steps: int = 24) -> dict:
     return out
 
 
+def _fleet_probe(steps: int = 6) -> dict:
+    """Self-driving fleet probe (docs/ROBUSTNESS.md "Self-driving fleet").
+
+    Drives a tiny fleet-managed Trainer with a skew-injecting drain
+    (``testing/faults.skewed_drain``) so the drift detector arms a
+    model-only retune and executes a live layout migration at the first
+    checkpoint boundary. Reports the retune wall-clock (the cost-model
+    fast path the controller runs in-job), the end-to-end migration
+    wall-clock (blocking save -> rebuild -> elastic restore -> swap) and
+    the migration downtime in steps (boundary step minus arming step —
+    the window the job kept training on the stale layout). The HBM
+    budget handed to the cost model is sized between the MEM-OPT and
+    COMM-OPT footprints so the retune MUST move off the starting
+    COMM-OPT layout.
+    """
+    import tempfile
+    import warnings as pywarnings
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import kfac_tpu
+    from kfac_tpu.autotune import model as autotune_model
+    from kfac_tpu.autotune import search as autotune_search
+    from kfac_tpu.models import MLP
+    from testing import faults
+
+    # d=16 keeps the cost-model ranking honest for the story below:
+    # unconstrained, COMM-OPT genuinely wins (comm-free grad workers),
+    # so the starting plan is a real frac-1.0 layout; under the tight
+    # budget the frac-1.0 footprint is infeasible and MEM-OPT takes it
+    d = 16
+    world = jax.device_count()
+    model = MLP(features=(d, d), num_classes=8)
+    x = jax.random.normal(jax.random.PRNGKey(9), (64, d))
+    y = jax.random.normal(jax.random.PRNGKey(10), (64, 8))
+    params = model.init(jax.random.PRNGKey(11), x)['params']
+    reg = kfac_tpu.register_model(model, x)
+
+    def loss_fn(p, model_state, batch):
+        xx, yy = batch
+        pred = model.apply({'params': p}, xx)
+        return jnp.mean((pred - yy) ** 2), model_state
+
+    def bare():
+        return kfac_tpu.KFACPreconditioner(
+            registry=reg, damping=1e-3, lr=0.1, flight=8
+        )
+
+    # the stale starting point: a plan genuinely tuned to COMM-OPT
+    plan = autotune_search.autotune(
+        bare(), measure=False, world=world,
+        fractions=(1.0,), granularities=(1,),
+    )
+    rows = [
+        autotune_model.predict(c, bare(), world)
+        for c in autotune_search.baseline_candidates(world, bare())
+    ]
+    mems = sorted(r['memory_per_device_bytes']['total'] for r in rows)
+    tight = autotune_model.HardwareSpec(hbm_bytes=(mems[0] + mems[-1]) / 2)
+
+    with tempfile.TemporaryDirectory() as td:
+        mgr = kfac_tpu.CheckpointManager(
+            td, save_interval_steps=4, keep=2,
+            install_signals=(), async_save=False,
+        )
+        ctrl = kfac_tpu.FleetController(
+            mgr,
+            kfac_tpu.FleetConfig(
+                check_every=2, drift_keys=('grad_norm',),
+                drift_threshold=0.5, drift_window=2, drift_patience=1,
+                cooldown_steps=8,
+            ),
+            plan=plan, hardware=tight,
+            drain=faults.skewed_drain('grad_norm', 2.0),
+        )
+        trainer = kfac_tpu.Trainer(
+            loss_fn=loss_fn, optimizer=optax.sgd(0.05),
+            kfac=bare(), fleet=ctrl,
+        )
+        workers_before = ctrl.engine.grad_workers
+        state = trainer.init(params)
+        with pywarnings.catch_warnings():
+            pywarnings.simplefilter('ignore')
+            for _ in range(steps):
+                state, last = trainer.step(state, (x, y))
+        jax.block_until_ready(last)
+        return {
+            'fleet_probe_config': f'mlp_d{d}_world{world}',
+            'migrations': ctrl.stats['migrations'],
+            'aborts': ctrl.stats['aborts'],
+            'retune_wall_s': round(ctrl.stats['retune_s'] or 0.0, 6),
+            'migration_wall_s': round(ctrl.stats['migration_s'] or 0.0, 3),
+            'migration_downtime_steps': ctrl.stats['downtime_steps'],
+            'grad_workers_before': workers_before,
+            'grad_workers_after': ctrl.engine.grad_workers,
+            'events': [e['event'] for e in ctrl.events],
+        }
+
+
 def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     """Observability probe: per-step metrics JSONL, metrics-on overhead vs
     a metrics-off loop timed back-to-back, and a phase-level step-time
@@ -569,6 +670,11 @@ def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     _atomic_write(out_path, result)
     _log('  compression/offload probe (int8 vs f32 wire, cold factors)')
     result['compression_probe'] = _compression_probe()
+
+    # self-driving fleet probe: drift retune + live migration downtime
+    _atomic_write(out_path, result)
+    _log('  fleet probe (model-only retune + migration downtime)')
+    result['fleet_probe'] = _fleet_probe()
 
 
 # ---------------------------------------------------------------------------

@@ -16,7 +16,12 @@ from kfac_tpu import resilience
 from kfac_tpu.autotune import TunedPlan
 from kfac_tpu.async_inverse import AsyncInverseConfig
 from kfac_tpu.compression import CompressionConfig, OffloadConfig
-from kfac_tpu.resilience import CheckpointManager, Preempted
+from kfac_tpu.resilience import (
+    CheckpointManager,
+    FleetConfig,
+    FleetController,
+    Preempted,
+)
 from kfac_tpu.health import HealthConfig, HealthState
 from kfac_tpu.observability import (
     FlightRecorderConfig,
@@ -52,6 +57,8 @@ __all__ = [
     'ComputeMethod',
     'CurvatureCapture',
     'DistributedStrategy',
+    'FleetConfig',
+    'FleetController',
     'FlightRecorderConfig',
     'HealthConfig',
     'HealthState',

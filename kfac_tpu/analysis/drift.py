@@ -1,4 +1,4 @@
-"""KFL100–KFL105: the migrated docs-vs-code drift linters.
+"""KFL100–KFL106: the migrated docs-vs-code drift linters.
 
 These are ``kind='project'`` rules — unlike the AST rules they import
 the live ``kfac_tpu`` modules and compare real objects (metric schemas,
@@ -349,6 +349,37 @@ def _compression_knobs() -> list[core.Finding]:
     return _doc_findings('KFL105', ARCHITECTURE_DOC, line, problems)
 
 
+# ------------------------------------------------------ KFL106 fleet knobs
+
+
+def check_fleet_knobs(doc_path: str = ROBUSTNESS_DOC) -> list[str]:
+    """Drift between the docs/ROBUSTNESS.md fleet knob table and the
+    ``FleetConfig`` dataclass fields — the policy knobs the self-driving
+    fleet controller actually accepts."""
+    import dataclasses
+
+    section, _ = doc_section(doc_path, '### Fleet knobs')
+    documented = table_first_cells(section)
+    from kfac_tpu.resilience import fleet as fleet_lib
+
+    actual = {f.name for f in dataclasses.fields(fleet_lib.FleetConfig)}
+    problems = []
+    for k in sorted(actual - documented):
+        problems.append(f'undocumented config field (add to {doc_path}): {k}')
+    for k in sorted(documented - actual):
+        problems.append(f'documented knob is not a FleetConfig field: {k}')
+    return problems
+
+
+def _fleet_knobs() -> list[core.Finding]:
+    try:
+        _, line = doc_section(ROBUSTNESS_DOC, '### Fleet knobs')
+        problems = check_fleet_knobs()
+    except (OSError, ValueError) as exc:
+        return _doc_findings('KFL106', ROBUSTNESS_DOC, 1, [str(exc)])
+    return _doc_findings('KFL106', ROBUSTNESS_DOC, line, problems)
+
+
 # --------------------------------------------------------------- registration
 
 
@@ -421,5 +452,17 @@ core.register(core.Rule(
         'memory residency; an undocumented (or phantom) knob is how a '
         'convergence regression gets configured by folklore',
     check=_compression_knobs,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL106',
+    name='fleet-knobs-doc',
+    what='drift between the docs/ROBUSTNESS.md "Fleet knobs" table and '
+         'the FleetConfig dataclass fields',
+    why='the fleet knobs gate when a live job re-layouts itself; an '
+        'undocumented (or phantom) knob turns an autonomous migration '
+        'policy into a surprise',
+    check=_fleet_knobs,
     kind='project',
 ))

@@ -26,6 +26,7 @@ from kfac_tpu.autotune.plan import (
     PLAN_SCHEMA_VERSION,
     TunedPlan,
     apply_knobs,
+    fingerprint_diff,
     fingerprint_matches,
     plan_fingerprint,
     plan_schema_keys,
@@ -51,6 +52,7 @@ __all__ = [
     'baseline_candidates',
     'candidate_config',
     'enumerate_candidates',
+    'fingerprint_diff',
     'fingerprint_matches',
     'measure_candidate',
     'plan_fingerprint',

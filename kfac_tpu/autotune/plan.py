@@ -98,6 +98,19 @@ def fingerprint_matches(a: dict[str, Any], b: dict[str, Any]) -> bool:
     return json.loads(json.dumps(a)) == json.loads(json.dumps(b))
 
 
+def fingerprint_diff(a: dict[str, Any], b: dict[str, Any]) -> list[str]:
+    """Keys whose values differ between two fingerprints, in EITHER
+    direction (sorted), after JSON normalization.
+
+    A one-sided scan would miss keys present in only one fingerprint —
+    e.g. a plan from a newer schema carrying a field this process
+    doesn't produce — and report an empty diff for a real mismatch.
+    """
+    na = json.loads(json.dumps(a))
+    nb = json.loads(json.dumps(b))
+    return sorted(k for k in set(na) | set(nb) if na.get(k) != nb.get(k))
+
+
 @dataclasses.dataclass
 class TunedPlan:
     """Versioned, serializable result of a layout search.
@@ -233,12 +246,7 @@ def resolve_auto_layout(
     plan = as_plan(auto_layout)
     current = plan_fingerprint(config.registry)
     if not fingerprint_matches(plan.fingerprint, current):
-        diff = [
-            k
-            for k in current
-            if json.loads(json.dumps(plan.fingerprint.get(k)))
-            != json.loads(json.dumps(current[k]))
-        ]
+        diff = fingerprint_diff(plan.fingerprint, current)
         warnings_lib.warn_layout_event(
             'fingerprint-mismatch',
             f'plan was tuned for a different {"/".join(diff) or "setup"}',

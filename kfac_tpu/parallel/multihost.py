@@ -157,6 +157,24 @@ def agree_emergency(code: int, step: int) -> tuple[int, int]:
     return int(gathered[:, 0].max()), int(gathered[:, 1].max())
 
 
+def agree_decision(ok: bool) -> bool:
+    """Pod-unanimous go/no-go vote: True only when EVERY process voted
+    True.
+
+    The fleet controller's live layout migration uses this as its commit
+    gate — any host whose save/rebuild/elastic-restore failed vetoes the
+    swap pod-wide, so no host ever trains under a layout its peers
+    failed to reach. Built on :func:`allgather_scalars` (min-reduction
+    over one fixed-shape gather), so single-process it is a pure-Python
+    identity; every process must call it at the same point in its call
+    sequence (SPMD symmetry).
+    """
+    if jax.process_count() == 1:
+        return bool(ok)
+    gathered = allgather_scalars([1.0 if ok else 0.0])
+    return bool(gathered[:, 0].min() >= 0.5)
+
+
 def assert_same_step(step: int, what: str = 'restored checkpoint') -> None:
     """Verify every process agrees on ``step``; raise naming the spread.
 

@@ -6,9 +6,17 @@ periodic async saves from the Trainer step paths, flushes an emergency
 blocking save when a preemption signal arrives, and restores the newest
 *good* checkpoint with last-good fallback and elastic cross-topology
 migration. See docs/ROBUSTNESS.md ("Preemption & resume").
+
+``FleetController`` closes the loop into a self-driving fleet: restores
+onto a changed topology re-tune the layout through the autotuner's
+cost-model-only fast path, and sustained cross-host drift (flight-
+recorder skew columns) triggers a pod-coordinated live layout migration
+at the next checkpoint boundary. See docs/ROBUSTNESS.md ("Self-driving
+fleet").
 """
 
 from kfac_tpu.resilience import signals
+from kfac_tpu.resilience.fleet import FleetConfig, FleetController
 from kfac_tpu.resilience.manager import (
     CheckpointManager,
     Preempted,
@@ -17,6 +25,8 @@ from kfac_tpu.resilience.manager import (
 
 __all__ = [
     'CheckpointManager',
+    'FleetConfig',
+    'FleetController',
     'Preempted',
     'RestoreResult',
     'signals',

@@ -70,6 +70,16 @@ class Trainer:
             so the plan can pick both the config knobs and the mesh. A
             fingerprint mismatch falls back to the default layout with a
             rate-limited :class:`~kfac_tpu.warnings.LayoutPlanWarning`.
+        fleet: optional
+            :class:`kfac_tpu.resilience.FleetController`. Like
+            ``auto_layout`` it requires a bare
+            :class:`kfac_tpu.KFACPreconditioner` config (and excludes
+            ``auto_layout`` — the fleet owns the plan lifecycle): the
+            controller builds the engine under the freshest plan for the
+            live topology (re-tuning on a fingerprint mismatch), takes
+            over the ``checkpoints`` slot with its own manager, drives
+            drift checks/migrations from every step path, and serves
+            :meth:`restore_latest` elastically.
     """
 
     loss_fn: Callable[..., Any]
@@ -80,8 +90,33 @@ class Trainer:
     donate_state: bool = False
     checkpoints: Any = None
     auto_layout: Any = None
+    fleet: Any = None
 
     def __post_init__(self) -> None:
+        if self.fleet is not None:
+            if self.auto_layout is not None:
+                raise ValueError(
+                    'Trainer(fleet=...) excludes auto_layout: the fleet '
+                    'controller owns the plan lifecycle (pass the plan '
+                    'to the FleetController instead)'
+                )
+            if self.kfac is None or hasattr(self.kfac, 'mesh'):
+                raise ValueError(
+                    'Trainer(fleet=...) requires kfac to be the bare '
+                    'KFACPreconditioner config: the fleet must be free '
+                    'to pick (and later migrate) the layout and mesh'
+                )
+            if (
+                self.checkpoints is not None
+                and self.checkpoints is not self.fleet.manager
+            ):
+                raise ValueError(
+                    'Trainer(fleet=...) uses the fleet controller\'s '
+                    'own CheckpointManager; drop the checkpoints= '
+                    'argument (or pass fleet.manager)'
+                )
+            self.checkpoints = self.fleet.manager
+            self.kfac = self.fleet.attach(self.kfac)
         if self.auto_layout is not None:
             if self.kfac is None:
                 raise ValueError(
@@ -256,6 +291,38 @@ class Trainer:
         if self._step_count is None:
             self.resume(state)
 
+    def rebind_engine(self, engine: Any) -> None:
+        """Swap in a rebuilt preconditioner engine (the fleet
+        controller's live layout migration).
+
+        Re-resolves the config-derived attributes and drops every
+        compiled step program: the new engine's state pytree generally
+        has a different structure (bucket shapes, shardings), and even
+        when it happens to match, a cached trace would keep executing
+        the OLD engine's collectives. The registry — and therefore the
+        curvature capture — is unchanged, so ``_run_stats`` survives.
+        """
+        self.kfac = engine
+        self._kfac_takes_loss = (
+            'loss' in inspect.signature(engine.step).parameters
+        )
+        cfg = engine.config if hasattr(engine, 'config') else engine
+        self.factor_update_steps = cfg.factor_update_steps
+        for attr in ('_jit_scan', '_jit_grads_stats', '_jit_grads_only',
+                     '_jit_apply_kfac', '_jit_accum_scan', '_executed'):
+            if hasattr(self, attr):
+                delattr(self, attr)
+        donate = (0,) if self.donate_state else ()
+        self._jit_with_stats = jax.jit(
+            self._step_with_stats, donate_argnums=donate
+        )
+        self._jit_no_stats = jax.jit(
+            self._step_no_stats, donate_argnums=donate
+        )
+        self._step_count = None  # resyncs from the next state's counter
+        if self.checkpoints is not None:
+            self.checkpoints.engine = engine
+
     def _capture_now(self) -> bool:
         """Evaluate the factor cadence host-side (schedules are pure
         functions of the step, so the host can run them concretely)."""
@@ -354,6 +421,15 @@ class Trainer:
             )
         self.checkpoints.on_step(view, step=self._step_count)
 
+    def _drive_fleet(self, state: TrainState) -> TrainState:
+        """Tick the fleet controller after a completed step (no-op
+        without one). Returns the possibly-migrated TrainState — a live
+        layout migration at a checkpoint boundary swaps both the engine
+        (via :meth:`rebind_engine`) and the state mid-loop."""
+        if self.fleet is None:
+            return state
+        return self.fleet.on_step(self, state)
+
     def restore_latest(
         self, params: Any, model_state: Any = None
     ) -> TrainState | None:
@@ -361,11 +437,16 @@ class Trainer:
         checkpoint.
 
         ``params``/``model_state`` serve as restore templates (shapes,
-        dtypes, shardings — e.g. from ``model.init``) and are returned
-        unchanged when the rotation is empty (fresh start). On success
-        the returned TrainState carries the restored params, optimizer
-        state, model state, and rematerialized K-FAC state, and the
-        Trainer's cadence dispatch is re-aligned to the restored step.
+        dtypes, shardings — e.g. from ``model.init``); they are never
+        mutated. Returns ``None`` when the rotation holds no restorable
+        checkpoint (fresh start — call :meth:`init` with the same
+        templates to begin training). On success the returned TrainState
+        carries the restored params, optimizer state, model state, and
+        rematerialized K-FAC state, and the Trainer's cadence dispatch
+        is re-aligned to the restored step. With a ``fleet`` controller
+        the restore is elastic (:meth:`FleetController.restore_elastic`):
+        the checkpoint reshards into the freshest tuned layout, falling
+        back to the canonical one if that fails.
         """
         if self.checkpoints is None:
             raise ValueError(
@@ -378,9 +459,15 @@ class Trainer:
         }
         if model_state is not None:
             template['model_state'] = model_state
-        result = self.checkpoints.restore_latest(
-            engine=self.kfac, extra_template=template
-        )
+        if self.fleet is not None:
+            result = self.fleet.restore_elastic(extra_template=template)
+            if self.kfac is not self.fleet.engine:
+                # the tuned restore fell back to the canonical layout
+                self.rebind_engine(self.fleet.engine)
+        else:
+            result = self.checkpoints.restore_latest(
+                engine=self.kfac, extra_template=template
+            )
         if result is None:
             return None
         state = TrainState(
@@ -389,6 +476,21 @@ class Trainer:
             kfac_state=result.state,
             model_state=result.extra.get('model_state', model_state),
         )
+        mesh = getattr(self.kfac, 'mesh', None)
+        if mesh is not None:
+            # the extras restored into the CALLER's template placement
+            # (typically one device, from model.init); the engine state
+            # is committed to the mesh — replicate the extras onto it so
+            # the next step's jit sees one consistent device set
+            rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+            state = state._replace(
+                params=jax.device_put(state.params, rep),
+                opt_state=jax.device_put(state.opt_state, rep),
+                model_state=(
+                    None if state.model_state is None
+                    else jax.device_put(state.model_state, rep)
+                ),
+            )
         self.resume(state)
         return state
 
@@ -414,6 +516,9 @@ class Trainer:
         self._step_count += 1
         self._maybe_warn(out[0])
         self._drive_checkpoints(out[0])
+        new_state = self._drive_fleet(out[0])
+        if new_state is not out[0]:
+            out = (new_state, out[1])
         return out
 
     # ------------------------------------------------------- compiled loops
@@ -531,6 +636,7 @@ class Trainer:
         state, losses = self._jit_scan(state, batches)
         self._step_count = None  # host mirror resyncs from the device step
         self._drive_checkpoints(state)
+        state = self._drive_fleet(state)
         return state, losses
 
     # --------------------------------------------------------- accumulation
@@ -647,6 +753,7 @@ class Trainer:
         self._step_count += 1
         self._maybe_warn(new_state)
         self._drive_checkpoints(new_state)
+        new_state = self._drive_fleet(new_state)
         return new_state, loss
 
     @tracing.trace(name='trainer/step_accumulate')
@@ -753,6 +860,9 @@ class Trainer:
         self._step_count += 1
         self._maybe_warn(out[0])
         self._drive_checkpoints(out[0])
+        new_state = self._drive_fleet(out[0])
+        if new_state is not out[0]:
+            out = (new_state, out[1])
         return out
 
     def _apply_accumulated(

@@ -201,6 +201,35 @@ def test_fingerprint_mismatch_falls_back_with_one_warning():
         DistributedKFAC(config=cfg, auto_layout=plan)
 
 
+def test_fingerprint_diff_reports_both_directions():
+    cfg, *_ = _base()
+    current = plan_lib.plan_fingerprint(cfg.registry)
+    # a plan from an OLDER writer: one field doctored, one field the
+    # current fingerprint carries missing entirely, and one extra field
+    # only the plan has — the diff must surface all three
+    stale = json.loads(json.dumps(current))
+    stale['device_count'] = 4096
+    missing = sorted(set(stale) - {'layers'})[0]
+    del stale[missing]
+    stale['legacy_only_field'] = 1
+    diff = plan_lib.fingerprint_diff(stale, current)
+    assert 'device_count' in diff
+    assert missing in diff  # current-only key (old one-sided scan got this)
+    assert 'legacy_only_field' in diff  # plan-only key (it missed this)
+    assert diff == sorted(diff)
+    # identical fingerprints (JSON-normalized tuples included) diff empty
+    assert plan_lib.fingerprint_diff(current, json.loads(
+        json.dumps(current))) == []
+    # and the resolve-time warning names the plan-only key too
+    doctored = autotune.autotune(cfg, measure=False).to_json()
+    doctored['fingerprint']['legacy_only_field'] = 1
+    reset_layout_warnings()
+    with pytest.warns(LayoutPlanWarning, match='legacy_only_field'):
+        eng = DistributedKFAC(config=cfg, auto_layout=doctored)
+    assert not eng.auto_layout_applied
+    reset_layout_warnings()
+
+
 def test_model_fingerprint_mismatch_rejected():
     cfg, *_ = _base()
     plan = autotune.autotune(cfg, measure=False)

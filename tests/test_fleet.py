@@ -1,0 +1,580 @@
+"""Self-driving fleet controller tests on the 8-virtual-device CPU mesh.
+
+Covers the ISSUE acceptance surface end to end, entirely on CPU:
+
+- retune-on-restore: train, doctor the persisted plan's fingerprint
+  with ``testing.faults.change_topology`` (the "restored onto a resized
+  pod" fault), and assert the fresh controller re-runs the cost-model
+  fast path, lands on the NEWLY tuned layout (not the canonical
+  defaults, not the stale plan), restores elastically, and continues
+  with loss continuity against the uninterrupted run;
+- drift-triggered live migration: a skew-injecting drain
+  (``testing.faults.skewed_drain``) arms a retune whose migration
+  executes at the next checkpoint boundary with bit-identical params
+  versus a calm control run, plus the abort-and-rollback path when the
+  pod-wide agreement vote fails;
+- the unit surface: FleetConfig validation, retune retry/backoff,
+  canonical fallbacks (permanent retune failure, tuned-restore
+  failure), the Trainer constructor guards, and the deterministic
+  fault injectors themselves.
+
+The tuned-vs-default distinction is driven through the cost model's
+public HBM budget: ``HardwareSpec(hbm_bytes=...)`` sized between the
+MEM-OPT and COMM-OPT footprints makes every fraction-1.0 candidate
+infeasible, so the model-only retune MUST move off the canonical
+COMM-OPT layout — no monkeypatching of the search involved.
+"""
+
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import kfac_tpu
+from kfac_tpu.autotune import model as model_lib
+from kfac_tpu.autotune import search as search_lib
+from kfac_tpu.enums import DistributedStrategy
+from kfac_tpu.parallel import multihost
+from kfac_tpu.resilience import CheckpointManager, fleet as fleet_lib
+from kfac_tpu.warnings import (
+    FleetWarning,
+    reset_fleet_warnings,
+    reset_layout_warnings,
+)
+from testing import faults, models
+
+WORLD = 8
+
+#: sized between the MEM-OPT (~4.7 kB) and COMM-OPT (~11.4 kB) per-device
+#: footprints of the TinyModel factor state, so fraction-1.0 candidates
+#: are infeasible and the model-only retune must leave the canonical
+#: COMM-OPT layout
+TIGHT_HBM = model_lib.HardwareSpec(hbm_bytes=8000.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_warning_state():
+    reset_fleet_warnings()
+    reset_layout_warnings()
+    yield
+    reset_fleet_warnings()
+    reset_layout_warnings()
+
+
+def _setup():
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+
+    def loss_fn(p, model_state, batch):
+        bx, by = batch
+        pred = m.apply({'params': p}, bx)
+        return jnp.mean((pred - by) ** 2), model_state
+
+    def bare():
+        return kfac_tpu.KFACPreconditioner(
+            registry=reg, kl_clip=None, damping=1e-3, flight=8
+        )
+
+    return m, (x, y), params, bare, loss_fn
+
+
+def _fast_config(**kw):
+    base = dict(
+        check_every=2, drift_keys=('grad_norm',), drift_threshold=0.5,
+        drift_window=2, drift_patience=1, cooldown_steps=1,
+    )
+    base.update(kw)
+    return kfac_tpu.FleetConfig(**base)
+
+
+def _make_fleet(directory, bare, loss_fn, *, ratio=0.0, hardware=None,
+                plan=None, config=None, save_interval_steps=4):
+    mgr = CheckpointManager(
+        directory, save_interval_steps=save_interval_steps, keep=3,
+        install_signals=(), async_save=False,
+    )
+    ctrl = kfac_tpu.FleetController(
+        mgr,
+        config if config is not None else _fast_config(),
+        plan=plan,
+        hardware=hardware if hardware is not None else TIGHT_HBM,
+        drain=faults.skewed_drain('grad_norm', ratio),
+    )
+    trainer = kfac_tpu.Trainer(
+        loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=bare(), fleet=ctrl,
+    )
+    return trainer, mgr, ctrl
+
+
+def _comm_opt_plan(bare):
+    """A genuinely tuned plan pinned to the canonical COMM-OPT layout —
+    the 'stale' starting point the fleet must move away from."""
+    return search_lib.autotune(
+        bare(), measure=False, world=WORLD,
+        fractions=(1.0,), granularities=(1,),
+    )
+
+
+# ------------------------------------------------------------ config surface
+
+
+def test_fleet_config_validation():
+    assert kfac_tpu.FleetConfig().check_every == 16
+    # list drift_keys normalize to a tuple (hashable, lint-friendly)
+    assert kfac_tpu.FleetConfig(drift_keys=['loss']).drift_keys == ('loss',)
+    for bad in (
+        dict(check_every=0), dict(drift_keys=()), dict(drift_threshold=0.0),
+        dict(drift_window=0), dict(drift_patience=0),
+        dict(cooldown_steps=-1), dict(retune_max_retries=-1),
+        dict(retune_backoff_base=0.0), dict(retune_backoff_max=0.0),
+    ):
+        with pytest.raises(ValueError):
+            kfac_tpu.FleetConfig(**bad)
+
+
+def test_controller_rejects_unknown_search_overrides(tmp_path):
+    mgr = CheckpointManager(tmp_path, install_signals=(), async_save=False)
+    with pytest.raises(ValueError, match='unknown search_overrides'):
+        kfac_tpu.FleetController(mgr, search_overrides={'granularity': (1,)})
+
+
+def test_attach_rejects_built_engine(tmp_path):
+    _, _, _, bare, _ = _setup()
+    from kfac_tpu.parallel import DistributedKFAC
+
+    mgr = CheckpointManager(tmp_path, install_signals=(), async_save=False)
+    ctrl = kfac_tpu.FleetController(mgr)
+    with pytest.raises(ValueError, match='bare KFACPreconditioner'):
+        ctrl.attach(DistributedKFAC(config=bare()))
+
+
+def test_trainer_fleet_constructor_guards(tmp_path):
+    _, _, _, bare, loss_fn = _setup()
+    from kfac_tpu.parallel import DistributedKFAC
+
+    mgr = CheckpointManager(tmp_path, install_signals=(), async_save=False)
+    ctrl = kfac_tpu.FleetController(mgr)
+    with pytest.raises(ValueError, match='excludes auto_layout'):
+        kfac_tpu.Trainer(
+            loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=bare(),
+            fleet=ctrl, auto_layout={'schema': 1},
+        )
+    with pytest.raises(ValueError, match='bare'):
+        kfac_tpu.Trainer(
+            loss_fn=loss_fn, optimizer=optax.sgd(0.05),
+            kfac=DistributedKFAC(config=bare()), fleet=ctrl,
+        )
+    other = CheckpointManager(
+        tmp_path / 'other', install_signals=(), async_save=False
+    )
+    with pytest.raises(ValueError, match='fleet controller'):
+        kfac_tpu.Trainer(
+            loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=bare(),
+            fleet=ctrl, checkpoints=other,
+        )
+
+
+# ------------------------------------------------------------ retune-on-path
+
+
+def test_retune_retry_backoff_then_success(tmp_path, monkeypatch):
+    _, _, _, bare, _ = _setup()
+    mgr = CheckpointManager(tmp_path, install_signals=(), async_save=False)
+    delays = []
+    ctrl = kfac_tpu.FleetController(
+        mgr, kfac_tpu.FleetConfig(retune_max_retries=3),
+        hardware=TIGHT_HBM, sleep=delays.append,
+    )
+    real = search_lib.autotune
+    calls = {'n': 0}
+
+    def flaky(*a, **kw):
+        calls['n'] += 1
+        if calls['n'] <= 2:
+            raise OSError('transient search scratch failure')
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fleet_lib.search_lib, 'autotune', flaky)
+    engine = ctrl.attach(bare())
+    # two failures -> two exponential backoffs, then the tuned engine
+    assert delays == [0.5, 1.0]
+    assert calls['n'] == 3
+    assert ctrl.plan is not None
+    assert ctrl.plan.meta['retune_reason'] == 'startup'
+    assert ctrl.plan.meta['fleet'] is True
+    assert ctrl.stats['retunes'] == 1
+    assert ctrl.stats['retune_s'] is not None
+    assert engine is ctrl.engine is mgr.engine
+
+
+def test_retune_permanent_failure_falls_back_to_canonical(
+    tmp_path, monkeypatch
+):
+    _, _, _, bare, _ = _setup()
+    mgr = CheckpointManager(tmp_path, install_signals=(), async_save=False)
+    ctrl = kfac_tpu.FleetController(
+        mgr, kfac_tpu.FleetConfig(retune_max_retries=1),
+        sleep=lambda s: None,
+    )
+
+    def broken(*a, **kw):
+        raise OSError('no scratch space')
+
+    monkeypatch.setattr(fleet_lib.search_lib, 'autotune', broken)
+    with pytest.warns(FleetWarning, match='retune-failed'):
+        engine = ctrl.attach(bare())
+    # the job still comes up, on the canonical COMM-OPT layout
+    assert ctrl.plan is None
+    assert engine.grad_workers == WORLD
+    assert [e['event'] for e in ctrl.events] == ['retune-failed']
+    assert ctrl.stats['retunes'] == 0
+
+
+def test_unreadable_plan_warns_and_retunes(tmp_path):
+    _, _, _, bare, _ = _setup()
+    mgr = CheckpointManager(tmp_path, install_signals=(), async_save=False)
+    plan_path = os.path.join(mgr.directory, fleet_lib.PLAN_FILENAME)
+    with open(plan_path, 'w') as f:
+        f.write('{"schema": 999, "corrupt')
+    ctrl = kfac_tpu.FleetController(mgr, hardware=TIGHT_HBM)
+    with pytest.warns(FleetWarning, match='plan-unreadable'):
+        ctrl.attach(bare())
+    assert ctrl.plan is not None
+    assert ctrl.plan.meta['retune_reason'] == 'startup'
+    # the fresh plan overwrote the corrupt artifact
+    assert json.load(open(plan_path))['schema'] == ctrl.plan.schema
+
+
+def test_fleet_warnings_rate_limited_per_cause():
+    assert kfac_tpu.warnings.warn_fleet_event('x-cause', 'one') is True
+    assert kfac_tpu.warnings.warn_fleet_event('x-cause', 'two') is False
+    reset_fleet_warnings()
+    assert kfac_tpu.warnings.warn_fleet_event('x-cause', 'three') is True
+
+
+def test_agree_decision_single_process():
+    assert multihost.agree_decision(True) is True
+    assert multihost.agree_decision(False) is False
+
+
+# ------------------------------------------------------- fault injectors
+
+
+def test_change_topology_doctors_fingerprint_only(tmp_path):
+    _, _, _, bare, _ = _setup()
+    plan = _comm_opt_plan(bare)
+    doctored = faults.change_topology(plan)
+    # default fault: the pod doubled
+    assert doctored.fingerprint['device_count'] == 2 * WORLD
+    # knobs/cost table untouched, input unmutated
+    assert doctored.knobs == plan.knobs
+    assert plan.fingerprint['device_count'] == WORLD
+    # path form round-trips through disk
+    path = str(tmp_path / 'p.json')
+    plan.save(path)
+    back = faults.change_topology(path, process_count=4, backend='tpu')
+    again = type(plan).load(path)
+    assert again.fingerprint == back.fingerprint
+    assert back.fingerprint['process_count'] == 4
+    assert back.fingerprint['backend'] == 'tpu'
+
+
+def test_induce_skew_exact_ratio_and_unmutated_input():
+    from kfac_tpu.observability import flight_recorder as flight_lib
+
+    records = [
+        {'step': 1, 'grad_norm': 2.0},
+        {'step': 2, 'grad_norm': -4.0, 'skew_mean/grad_norm': -4.0},
+        {'step': 3, 'loss': 1.0},  # no grad_norm: untouched
+    ]
+    out = faults.induce_skew(records, key='grad_norm', ratio=2.0)
+    assert 'skew_min/grad_norm' not in records[0]
+    for rec in out[:2]:
+        assert flight_lib.skew_ratio(rec, 'grad_norm') == pytest.approx(2.0)
+    assert out[2] == records[2]
+    # skew_ratio needs all three columns
+    assert flight_lib.skew_ratio(records[0], 'grad_norm') == 0.0
+
+
+# ------------------------------------------- acceptance: retune-on-restore
+
+
+def test_topology_change_retunes_on_restore_with_loss_continuity(tmp_path):
+    m, batch, params, bare, loss_fn = _setup()
+    # phase 1: train under the tuned COMM-OPT plan, periodic saves
+    trainer, mgr, ctrl = _make_fleet(
+        tmp_path, bare, loss_fn,
+        hardware=model_lib.HardwareSpec(), plan=_comm_opt_plan(bare),
+    )
+    assert ctrl.engine.grad_workers == WORLD
+    state = trainer.init(params)
+    losses = []
+    for _ in range(6):
+        state, loss = trainer.step(state, batch)
+        losses.append(float(loss))
+    mgr.finalize()
+    assert mgr.latest_step() == 4
+    assert os.path.exists(ctrl.plan_path)
+
+    # the fault: the job comes back on a "resized pod" — the persisted
+    # plan's fingerprint no longer matches this topology
+    faults.change_topology(ctrl.plan_path)
+
+    # phase 2: a fresh controller on the same rotation, under an HBM
+    # budget that rules the stale COMM-OPT layout out
+    with pytest.warns(FleetWarning, match='topology-changed'):
+        trainer2, mgr2, ctrl2 = _make_fleet(tmp_path, bare, loss_fn)
+    # landed on the NEWLY tuned layout: not the canonical default
+    # (COMM-OPT, 8 gradient workers), not the stale plan (same)
+    assert ctrl2.plan is not None
+    assert ctrl2.plan.meta['retune_reason'] == 'topology-changed'
+    assert ctrl2.engine.grad_workers == 1
+    assert ctrl2.engine.strategy == DistributedStrategy.MEM_OPT
+    # the retuned plan replaced the stale artifact on disk
+    assert json.load(open(ctrl.plan_path))['fingerprint']['device_count'] \
+        == WORLD
+
+    # elastic restore into the tuned layout, then exact continuity
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        state2 = trainer2.restore_latest(params)
+    assert state2 is not None
+    assert int(jax.device_get(state2.kfac_state.step)) == 4
+    for i in range(4, 6):
+        state2, loss = trainer2.step(state2, batch)
+        np.testing.assert_allclose(float(loss), losses[i], rtol=1e-4)
+
+
+def test_tuned_restore_falls_back_to_canonical(tmp_path, monkeypatch):
+    m, batch, params, bare, loss_fn = _setup()
+    trainer, mgr, ctrl = _make_fleet(
+        tmp_path, bare, loss_fn,
+        hardware=model_lib.HardwareSpec(), plan=_comm_opt_plan(bare),
+    )
+    state = trainer.init(params)
+    for _ in range(4):
+        state, _ = trainer.step(state, batch)
+    mgr.finalize()
+    assert mgr.latest_step() == 4
+
+    trainer2, mgr2, ctrl2 = _make_fleet(
+        tmp_path, bare, loss_fn,
+        hardware=model_lib.HardwareSpec(), plan=_comm_opt_plan(bare),
+    )
+    tuned_engine = ctrl2.engine
+    real = mgr2.restore_latest
+
+    def poisoned(engine=None, **kw):
+        if engine is tuned_engine:
+            raise OSError('reshard scratch exhausted')
+        return real(engine=engine, **kw)
+
+    monkeypatch.setattr(mgr2, 'restore_latest', poisoned)
+    with pytest.warns(FleetWarning, match='tuned-restore-failed'):
+        with warnings.catch_warnings():
+            warnings.simplefilter('always')
+            state2 = trainer2.restore_latest(params)
+    # the canonical fallback engine took over end to end
+    assert state2 is not None
+    assert int(jax.device_get(state2.kfac_state.step)) == 4
+    assert ctrl2.plan is None
+    assert ctrl2.engine is not tuned_engine
+    assert trainer2.kfac is ctrl2.engine is mgr2.engine
+    assert [e['event'] for e in ctrl2.events][-1] == 'restore-fallback'
+    # and the fallback engine actually steps
+    state2, _ = trainer2.step(state2, batch)
+    assert trainer2._step_count == 5
+
+
+def test_restore_elastic_empty_rotation_returns_none(tmp_path):
+    _, _, params, bare, loss_fn = _setup()
+    trainer, mgr, ctrl = _make_fleet(
+        tmp_path, bare, loss_fn, hardware=model_lib.HardwareSpec(),
+    )
+    assert trainer.restore_latest(params) is None
+    # params template was never mutated by the attempt
+    assert set(params) == {'fc1', 'fc2'}
+
+
+# ------------------------------------- acceptance: drift-triggered migration
+
+
+def _run_paired(trainer_a, trainer_b, params, batch, n, caught=None):
+    """Step two trainers in lockstep; warnings are silenced, or recorded
+    into ``caught`` when a list is passed."""
+    sa = trainer_a.init(params)
+    sb = trainer_b.init(params)
+    la, lb, params4 = [], [], None
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter('always' if caught is not None else 'ignore')
+        for i in range(n):
+            sa, a = trainer_a.step(sa, batch)
+            sb, b = trainer_b.step(sb, batch)
+            la.append(float(a))
+            lb.append(float(b))
+            if i == 3:
+                params4 = jax.device_get(sb.params)
+    if caught is not None:
+        caught.extend(rec)
+    return sa, sb, la, lb, params4
+
+
+def test_drift_migration_at_boundary_bit_identical(tmp_path):
+    m, batch, params, bare, loss_fn = _setup()
+    # drifting run: every drained record reports 2x relative skew;
+    # calm control: same controller, zero skew. Both start from the
+    # tuned COMM-OPT plan the drift retune (tight HBM budget) must leave.
+    plan = _comm_opt_plan(bare)
+    trainer, mgr, ctrl = _make_fleet(
+        tmp_path / 'a', bare, loss_fn, ratio=2.0, plan=plan,
+    )
+    control, _, ctrl_c = _make_fleet(
+        tmp_path / 'b', bare, loss_fn, ratio=0.0, plan=plan,
+    )
+    assert ctrl.engine.grad_workers == WORLD  # COMM-OPT until drift
+    _, _, la, lb, params4 = _run_paired(trainer, control, params, batch, 6)
+
+    # drift detected at the first full-window check (step 2), migration
+    # executed at the step-4 checkpoint boundary
+    names = [e['event'] for e in ctrl.events]
+    assert names[:4] == ['drift', 'retune', 'armed', 'migrated']
+    assert ctrl_c.events == []  # the calm pod never re-layouts
+    assert ctrl.stats['migrations'] == 1
+    assert ctrl.stats['aborts'] == 0
+    assert ctrl.stats['downtime_steps'] == 2  # armed at 2, executed at 4
+    assert ctrl.stats['migration_s'] > 0
+    # the live engine moved off the canonical layout pod-wide
+    assert ctrl.engine.grad_workers == 1
+    assert ctrl.engine.strategy == DistributedStrategy.MEM_OPT
+    assert trainer.kfac is ctrl.engine is mgr.engine
+    # loss continuity through the migration
+    np.testing.assert_allclose(la, lb, rtol=1e-6)
+
+    # bit-identical params across the migration: the rotation's step-4
+    # checkpoint restored into the new layout must round-trip exactly
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        restored = trainer.restore_latest(params)
+    assert int(jax.device_get(restored.kfac_state.step)) == 4
+    for layer in ('fc1', 'fc2'):
+        for leaf in ('kernel', 'bias'):
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(restored.params[layer][leaf])),
+                np.asarray(params4[layer][leaf]),
+                err_msg=f'{layer}/{leaf}',
+            )
+
+
+def test_drift_migration_rollback_on_agreement_failure(
+    tmp_path, monkeypatch
+):
+    m, batch, params, bare, loss_fn = _setup()
+    plan = _comm_opt_plan(bare)
+    # the long cooldown keeps the pod from re-arming after the abort
+    cfg = _fast_config(cooldown_steps=16)
+    trainer, mgr, ctrl = _make_fleet(
+        tmp_path / 'a', bare, loss_fn, ratio=2.0, plan=plan, config=cfg,
+    )
+    control, _, _ = _make_fleet(
+        tmp_path / 'b', bare, loss_fn, ratio=0.0, plan=plan, config=cfg,
+    )
+    old_engine = ctrl.engine
+    # a peer host votes the migration down (e.g. its reshard failed)
+    monkeypatch.setattr(
+        fleet_lib.multihost, 'agree_decision', lambda ok: False
+    )
+    caught: list = []
+    sa, sb, la, lb, _ = _run_paired(
+        trainer, control, params, batch, 6, caught=caught
+    )
+    assert any(
+        isinstance(w.message, FleetWarning)
+        and 'migration-aborted' in str(w.message)
+        for w in caught
+    )
+
+    names = [e['event'] for e in ctrl.events]
+    assert 'migration-aborted' in names
+    assert 'migrated' not in names
+    assert ctrl.stats['aborts'] == 1
+    assert ctrl.stats['migrations'] == 0
+    # rollback == nothing mutated: same engine, bit-identical trajectory
+    assert ctrl.engine is old_engine
+    assert trainer.kfac is old_engine
+    assert ctrl._pending_plan is None  # dropped, cooldown armed
+    np.testing.assert_allclose(la, lb, rtol=0)
+    for layer in ('fc1', 'fc2'):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(sa.params[layer]['kernel'])),
+            np.asarray(jax.device_get(sb.params[layer]['kernel'])),
+            err_msg=layer,
+        )
+
+
+def test_drift_without_periodic_saves_warns_and_stands_down(tmp_path):
+    m, batch, params, bare, loss_fn = _setup()
+    trainer, mgr, ctrl = _make_fleet(
+        tmp_path, bare, loss_fn, ratio=2.0, save_interval_steps=None,
+        plan=_comm_opt_plan(bare),
+    )
+    state = trainer.init(params)
+    with pytest.warns(FleetWarning, match='migration-disabled'):
+        for _ in range(2):
+            state, _ = trainer.step(state, batch)
+    assert [e['event'] for e in ctrl.events] == ['drift']
+    assert ctrl._pending_plan is None
+
+
+def test_drift_retune_noop_when_knobs_unchanged(tmp_path):
+    m, batch, params, bare, loss_fn = _setup()
+    # the current plan IS what the retune would pick: arm nothing
+    plan = search_lib.autotune(
+        bare(), measure=False, world=WORLD, hardware=TIGHT_HBM,
+    )
+    trainer, mgr, ctrl = _make_fleet(
+        tmp_path, bare, loss_fn, ratio=2.0, plan=plan,
+    )
+    state = trainer.init(params)
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        for _ in range(2):
+            state, _ = trainer.step(state, batch)
+    names = [e['event'] for e in ctrl.events]
+    assert names == ['drift', 'retune', 'retune-noop']
+    assert ctrl._pending_plan is None
+    assert ctrl.stats['migrations'] == 0
+
+
+def test_calm_pod_skips_drift_checks_off_cadence(tmp_path):
+    _, _, _, bare, loss_fn = _setup()
+    seen = []
+
+    def counting_drain(state):
+        seen.append(1)
+        return []
+
+    mgr = CheckpointManager(
+        tmp_path, save_interval_steps=4, install_signals=(),
+        async_save=False,
+    )
+    ctrl = kfac_tpu.FleetController(
+        mgr, _fast_config(check_every=4), drain=counting_drain,
+    )
+    trainer = kfac_tpu.Trainer(
+        loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=bare(), fleet=ctrl,
+    )
+    m, batch, params, _, _ = _setup()
+    state = trainer.init(params)
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        for _ in range(8):
+            state, _ = trainer.step(state, batch)
+    # drained only on the check_every cadence (steps 4 and 8)
+    assert len(seen) == 2

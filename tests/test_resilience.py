@@ -455,6 +455,82 @@ def test_elastic_restore_dense_and_stacked_via_manager(tmp_path):
             )
 
 
+@pytest.mark.faults
+def test_restore_latest_every_candidate_corrupt_returns_none(tmp_path):
+    """When EVERY rotation entry is unusable, restore_latest hands back
+    None (the fresh-start contract) after warning exactly once per
+    candidate — and a second walk over the same corpses stays quiet
+    (the per-path rate limit)."""
+    import warnings as warnings_mod
+
+    m, batch, params, reg, kfac = _dense_setup()
+    mgr = CheckpointManager(
+        tmp_path, engine=kfac, install_signals=(), async_save=False, keep=3
+    )
+    state = None
+    paths = []
+    for _ in range(3):
+        state, params, _ = _run_steps(
+            kfac, reg, m, params, batch, state=state
+        )
+        paths.append(mgr.save(state))
+    assert mgr.rotation_steps() == [3, 2, 1]
+    for path in paths:
+        corrupt_checkpoint(path, mode='truncate')
+    with warnings_mod.catch_warnings(record=True) as caught:
+        warnings_mod.simplefilter('always')
+        assert mgr.restore_latest() is None
+    unusable = [
+        w for w in caught
+        if isinstance(w.message, CheckpointResilienceWarning)
+        and 'unusable' in str(w.message)
+    ]
+    assert len(unusable) == 3
+    # rate-limited: the second walk re-visits no corpse loudly
+    with warnings_mod.catch_warnings():
+        warnings_mod.simplefilter('error', CheckpointResilienceWarning)
+        assert mgr.restore_latest() is None
+
+
+def test_elastic_restore_engine_overrides_manager_granularity(tmp_path):
+    """restore_latest(engine=...) with a DIFFERENT bucket granularity
+    than the manager's own engine migrates into the caller's layout —
+    the manager binding is a default, not a constraint (the fleet
+    controller's speculative-migration restore relies on this)."""
+    from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+
+    m, batch, params, reg, _ = _dense_setup()
+
+    def stacked(granularity):
+        return DistributedKFAC(
+            config=kfac_tpu.KFACPreconditioner(
+                registry=reg, kl_clip=None, bucket_granularity=granularity
+            ),
+            mesh=kaisa_mesh(grad_worker_fraction=0.5),
+        )
+
+    dk64 = stacked(64)
+    state, params, _ = _run_steps(dk64, reg, m, params, batch, steps=2)
+    mgr = CheckpointManager(
+        tmp_path, engine=dk64, install_signals=(), async_save=False
+    )
+    mgr.save(state)
+
+    dk128 = stacked(128)
+    with pytest.warns(UserWarning, match='migrating'):
+        result = mgr.restore_latest(engine=dk128)
+    assert result.step == 2
+    assert mgr.engine is dk64  # the binding itself is untouched
+    src = dk64.extract_factors(state)
+    dst = dk128.extract_factors(result.state)
+    for name, fg in src.items():
+        for side in ('a', 'g'):
+            np.testing.assert_allclose(
+                np.asarray(dst[name][side]), np.asarray(fg[side]),
+                rtol=1e-6, err_msg=f'{name}/{side}',
+            )
+
+
 # -------------------------------------------------------- Trainer lifecycle
 
 
