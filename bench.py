@@ -55,6 +55,7 @@ Extra fields in the JSON line:
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import signal
@@ -1127,6 +1128,68 @@ def _read_jsonl(path: str) -> list[dict]:
     return out
 
 
+def _run_id_epoch(run_id: str) -> float | None:
+    try:
+        return time.mktime(time.strptime(run_id, '%Y%m%d_%H%M%S'))
+    except (TypeError, ValueError):
+        return None
+
+
+def _tpu_replay() -> dict | None:
+    """Newest committed ``platform=tpu`` record, for probe-failure rounds.
+
+    A failed TPU probe used to leave the round JSON with nothing but
+    ``fallback: tpu_probe_failed`` — a consumer comparing rounds then sees
+    the CPU-smoke number where the previous round had a chip measurement
+    and reads it as a 20x regression. Replaying the newest committed TPU
+    evidence (value, MFU, run id, age) into the round keeps the best
+    known chip numbers attached to every round, clearly labelled as a
+    replay rather than a fresh measurement.
+    """
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates: list[tuple[str, dict]] = []
+    runs_dir = os.environ.get('BENCH_RUNS_DIR', 'bench_runs')
+    if not os.path.isabs(runs_dir):
+        runs_dir = os.path.join(here, runs_dir)
+    for path in sorted(glob.glob(os.path.join(runs_dir, 'run_*.json'))):
+        rec = _read_json(path)
+        if isinstance(rec, dict):
+            stem = os.path.basename(path)[len('run_'):-len('.json')]
+            rec.setdefault('run_id', stem)
+            candidates.append((path, rec))
+    # round-1..4 evidence predates the per-run record convention: the
+    # round files keep the parsed JSON line under 'parsed'
+    for path in sorted(glob.glob(os.path.join(here, 'BENCH_r*.json'))):
+        rec = _read_json(path).get('parsed')
+        if isinstance(rec, dict):
+            candidates.append((path, rec))
+    best = None
+    for path, rec in candidates:
+        if rec.get('platform') in _CPUISH:
+            continue
+        stamp = _run_id_epoch(rec.get('run_id'))
+        if stamp is None:
+            try:
+                stamp = os.path.getmtime(path)
+            except OSError:
+                continue
+        if best is None or stamp > best[0]:
+            best = (stamp, path, rec)
+    if best is None:
+        return None
+    stamp, path, rec = best
+    return {
+        'run_id': rec.get('run_id'),
+        'source': os.path.relpath(path, here),
+        'platform': rec.get('platform'),
+        'device_kind': rec.get('device_kind'),
+        'value': rec.get('value'),
+        'metric': rec.get('metric'),
+        'mfu': rec.get('mfu'),
+        'age_hours': round((time.time() - stamp) / 3600.0, 1),
+    }
+
+
 _HEADLINE_KEYS = (
     'platform', 'device_kind', 'model_config', 'clock_check_tflops',
     'sgd_tokens_per_sec', 'eager_tokens_per_sec', 'scan_tokens_per_sec',
@@ -1143,6 +1206,8 @@ _HEADLINE_KEYS = (
     'compression_probe',
     # active tuned layout plan, when KFAC_TUNE_PLAN is set (docs/AUTOTUNE.md)
     'tuned_plan',
+    # newest committed TPU evidence, replayed when the TPU probe fails
+    'tpu_replay',
 )
 
 
@@ -1159,6 +1224,9 @@ def _orchestrate(result: dict) -> None:
         result['platform'] = 'cpu'
         if os.environ.get('JAX_PLATFORMS') != 'cpu':
             result['fallback'] = 'tpu_probe_failed'
+            replay = _tpu_replay()
+            if replay is not None:
+                result['tpu_replay'] = replay
     tp = _active_plan()
     if tp is not None:
         result['tuned_plan'] = tp

@@ -36,9 +36,18 @@ from kfac_tpu.enums import (
     ComputeMethod,
     DistributedStrategy,
 )
+from kfac_tpu import laplace
+from kfac_tpu.laplace import (
+    LaplaceConfig,
+    LaplacePosterior,
+    export_posterior,
+    fit_prior_precision,
+    load_posterior,
+)
 from kfac_tpu.layers.capture import CapturedStats, CurvatureCapture
 from kfac_tpu.layers.registry import (
     Registry,
+    masked_registry,
     merge_registries,
     register_model,
 )
@@ -64,6 +73,8 @@ __all__ = [
     'HealthState',
     'KFACPreconditioner',
     'KFACState',
+    'LaplaceConfig',
+    'LaplacePosterior',
     'MetricsCollector',
     'MetricsConfig',
     'OffloadConfig',
@@ -79,7 +90,12 @@ __all__ = [
     'checkpoint',
     'default_compute_method',
     'enums',
+    'export_posterior',
+    'fit_prior_precision',
     'hyperparams',
+    'laplace',
+    'load_posterior',
+    'masked_registry',
     'merge_registries',
     'observability',
     'register_model',

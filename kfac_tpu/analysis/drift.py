@@ -1,4 +1,4 @@
-"""KFL100–KFL106: the migrated docs-vs-code drift linters.
+"""KFL100–KFL107: the migrated docs-vs-code drift linters.
 
 These are ``kind='project'`` rules — unlike the AST rules they import
 the live ``kfac_tpu`` modules and compare real objects (metric schemas,
@@ -30,6 +30,7 @@ OBSERVABILITY_DOC = 'docs/OBSERVABILITY.md'
 AUTOTUNE_DOC = 'docs/AUTOTUNE.md'
 ROBUSTNESS_DOC = 'docs/ROBUSTNESS.md'
 ARCHITECTURE_DOC = 'docs/ARCHITECTURE.md'
+LAPLACE_DOC = 'docs/LAPLACE.md'
 
 #: documented metric keys that are drain-record fields, not metric_keys
 #: entries (KFL102)
@@ -380,6 +381,51 @@ def _fleet_knobs() -> list[core.Finding]:
     return _doc_findings('KFL106', ROBUSTNESS_DOC, line, problems)
 
 
+# ---------------------------------------------------- KFL107 laplace knobs
+
+
+def check_laplace_knobs(doc_path: str = LAPLACE_DOC) -> list[str]:
+    """Drift between docs/LAPLACE.md and the Laplace serving surface:
+    the knob table vs the ``LaplaceConfig`` dataclass fields, and the
+    posterior-schema table vs ``posterior_schema_keys()`` (the keys
+    POSTERIOR.json actually persists)."""
+    import dataclasses
+
+    from kfac_tpu.laplace import config as laplace_config_lib
+    from kfac_tpu.laplace import export as laplace_export_lib
+
+    problems = []
+    section, _ = doc_section(doc_path, '### LaplaceConfig knobs')
+    documented = table_first_cells(section)
+    actual = {
+        f.name for f in dataclasses.fields(laplace_config_lib.LaplaceConfig)
+    }
+    for k in sorted(actual - documented):
+        problems.append(f'undocumented config field (add to {doc_path}): {k}')
+    for k in sorted(documented - actual):
+        problems.append(f'documented knob is not a LaplaceConfig field: {k}')
+
+    section, _ = doc_section(doc_path, '### Posterior schema')
+    documented = table_first_cells(section)
+    produced = set(laplace_export_lib.posterior_schema_keys())
+    for k in sorted(produced - documented):
+        problems.append(
+            f'undocumented posterior field (add to {doc_path}): {k}'
+        )
+    for k in sorted(documented - produced):
+        problems.append(f'documented field not in the posterior schema: {k}')
+    return problems
+
+
+def _laplace_knobs() -> list[core.Finding]:
+    try:
+        _, line = doc_section(LAPLACE_DOC, '### LaplaceConfig knobs')
+        problems = check_laplace_knobs()
+    except (OSError, ValueError) as exc:
+        return _doc_findings('KFL107', LAPLACE_DOC, 1, [str(exc)])
+    return _doc_findings('KFL107', LAPLACE_DOC, line, problems)
+
+
 # --------------------------------------------------------------- registration
 
 
@@ -464,5 +510,19 @@ core.register(core.Rule(
         'undocumented (or phantom) knob turns an autonomous migration '
         'policy into a surprise',
     check=_fleet_knobs,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL107',
+    name='laplace-knobs-doc',
+    what='drift between the docs/LAPLACE.md "LaplaceConfig knobs" / '
+         '"Posterior schema" tables and the LaplaceConfig dataclass '
+         'fields / posterior_schema_keys()',
+    why='exported posteriors are persisted, versioned JSON served across '
+        'sessions, and the knobs change the served uncertainty; schema '
+        'drift bricks saved posteriors and an undocumented knob mis-'
+        'calibrates them by folklore',
+    check=_laplace_knobs,
     kind='project',
 ))

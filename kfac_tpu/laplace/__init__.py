@@ -1,0 +1,33 @@
+"""KFAC-Laplace: serve the curvature K-FAC already maintains.
+
+The Kronecker factors a K-FAC engine runs training on double as a
+Laplace approximation of the weight posterior (Ritter et al. 2018):
+:func:`export_posterior` snapshots them (eigenbases + eigenvalues, mode
+dependent) into a versioned artifact, :func:`load_posterior` serves it —
+posterior weight samples, Monte-Carlo predictives, and the closed-form
+linearized variance in last-layer mode — and
+:func:`fit_prior_precision` tunes the prior on held-out data without
+re-exporting. See docs/LAPLACE.md.
+"""
+
+from kfac_tpu.laplace.config import LaplaceConfig
+from kfac_tpu.laplace.export import (
+    POSTERIOR_SCHEMA_VERSION,
+    export_posterior,
+    posterior_schema_keys,
+)
+from kfac_tpu.laplace.posterior import (
+    LaplacePosterior,
+    fit_prior_precision,
+    load_posterior,
+)
+
+__all__ = [
+    'LaplaceConfig',
+    'LaplacePosterior',
+    'POSTERIOR_SCHEMA_VERSION',
+    'export_posterior',
+    'fit_prior_precision',
+    'load_posterior',
+    'posterior_schema_keys',
+]

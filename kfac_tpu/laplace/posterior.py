@@ -1,0 +1,293 @@
+"""Load and serve an exported KFAC-Laplace posterior.
+
+:func:`load_posterior` validates the POSTERIOR.json schema (TunedPlan
+conventions: unknown/missing keys and schema-version mismatches are
+rejected up front, kfac_tpu/autotune/plan.py:from_json), rebuilds the
+layer helpers from their serialized constructor records — no model
+import needed — and hands back a :class:`LaplacePosterior` whose
+sampling/variance methods are pure functions of jax arrays, so they
+compose with jit/vmap at the serving site.
+
+Math (Ritter et al. 2018, KFAC-Laplace): per layer, the posterior over
+the packed weight matrix ``W`` (g_dim, a_dim) is
+
+    kron:  W ~ MAP + sqrt(T) * Qg diag(1/sqrt(dg + sqrt(p))) E
+                               diag(1/sqrt(da + sqrt(p))) Qa^T,
+           E ~ N(0, I), i.e. covariance T * (G + sqrt(p) I)^-1 (x)
+           (A + sqrt(p) I)^-1 — the Kronecker-wise damped inverse whose
+           composition approximates (H + p I)^-1.
+    diag:  W_ij ~ MAP + N(0, T / (dg_i * da_j + p)) in parameter
+           coordinates, with da/dg the FACTOR DIAGONALS.
+    last_layer: kron over one layer; additionally the closed-form
+           linearized predictive variance
+           var_k(x) = T * (phi~ Sigma_A phi~^T) * diag(Sigma_G)_k with
+           Sigma_A = Qa diag(1/(da + sqrt(p))) Qa^T (and G alike).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_tpu.laplace import config as config_lib
+from kfac_tpu.laplace import export as export_lib
+from kfac_tpu.layers import helpers as helpers_lib
+
+#: helper kinds a posterior doc may reference (export writes type names)
+_HELPER_KINDS = {
+    'DenseHelper': helpers_lib.DenseHelper,
+    'Conv2dHelper': helpers_lib.Conv2dHelper,
+    'LoRAHelper': helpers_lib.LoRAHelper,
+}
+
+
+def _as_tuple(v: Any) -> Any:
+    """JSON lists back to the tuples dataclass fields were written from."""
+    if isinstance(v, list):
+        return tuple(_as_tuple(x) for x in v)
+    return v
+
+
+def _helper_from_doc(entry: dict[str, Any]) -> helpers_lib.LayerHelper:
+    cls = _HELPER_KINDS.get(entry['kind'])
+    if cls is None:
+        raise ValueError(
+            f'posterior doc references unknown helper kind {entry["kind"]!r} '
+            f'(supported: {sorted(_HELPER_KINDS)})'
+        )
+    fields = {k: _as_tuple(v) for k, v in entry['fields'].items()}
+    fields['factor_dtype'] = np.dtype(fields['factor_dtype'])
+    return cls(**fields)
+
+
+def _subtree(params: Any, path: tuple[str, ...]) -> Any:
+    node = params
+    for key in path:
+        node = node[key]
+    return node
+
+
+def _merge(old: Any, new: Any) -> Any:
+    """Recursive dict merge: ``new`` leaves win, unmentioned keys survive
+    (a LoRA unit's sampled down/up kernels merge around the frozen base)."""
+    if not isinstance(new, dict):
+        return new
+    out = dict(old)
+    for k, v in new.items():
+        out[k] = _merge(old.get(k, {}), v) if isinstance(v, dict) else v
+    return out
+
+
+def _set_path(params: Any, path: tuple[str, ...], subtree: Any) -> Any:
+    if not path:
+        return _merge(params, subtree)
+    out = dict(params)
+    out[path[0]] = _set_path(out[path[0]], path[1:], subtree)
+    return out
+
+
+@dataclasses.dataclass
+class LaplacePosterior:
+    """A loaded (or freshly exported) serving posterior.
+
+    ``config`` may be replaced after load (``dataclasses.replace``) —
+    prior precision and temperature enter only at sample/variance time,
+    which is what makes :func:`fit_prior_precision` cheap.
+    """
+
+    config: config_lib.LaplaceConfig
+    params: Any
+    layers: dict[str, dict[str, jax.Array]]
+    helpers: dict[str, helpers_lib.LayerHelper]
+    param_paths: dict[str, tuple[str, ...]]
+    fingerprint: dict[str, Any] = dataclasses.field(default_factory=dict)
+    meta: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def _sample_matrix(
+        self, name: str, w_map: jax.Array, key: jax.Array
+    ) -> jax.Array:
+        cfg = self.config
+        arrs = self.layers[name]
+        p = jnp.asarray(cfg.prior_precision, w_map.dtype)
+        t = jnp.asarray(cfg.temperature, w_map.dtype)
+        noise = jax.random.normal(key, w_map.shape, w_map.dtype)
+        if cfg.mode == 'diag':
+            da = arrs['da'].astype(w_map.dtype)
+            dg = arrs['dg'].astype(w_map.dtype)
+            std = jnp.sqrt(t / (dg[:, None] * da[None, :] + p))
+            return w_map + std * noise
+        qa = arrs['qa'].astype(w_map.dtype)
+        qg = arrs['qg'].astype(w_map.dtype)
+        sa = 1.0 / jnp.sqrt(arrs['da'].astype(w_map.dtype) + jnp.sqrt(p))
+        sg = 1.0 / jnp.sqrt(arrs['dg'].astype(w_map.dtype) + jnp.sqrt(p))
+        delta = (qg * sg[None, :]) @ noise @ (qa * sa[None, :]).T
+        return w_map + jnp.sqrt(t) * delta
+
+    def sample_params(self, key: jax.Array) -> Any:
+        """One posterior draw of the full parameter pytree.
+
+        Pure in ``key`` and the stored arrays: jit- and vmap-compatible.
+        Layers outside the posterior (everything, in last-layer mode;
+        unregistered/frozen layers always) stay at their MAP values.
+        """
+        params = self.params
+        for i, name in enumerate(sorted(self.layers)):
+            helper = self.helpers[name]
+            path = self.param_paths[name]
+            sub = _subtree(self.params, path)
+            w_map = helper.grads_to_matrix(sub)
+            w = self._sample_matrix(
+                name, w_map, jax.random.fold_in(key, i)
+            )
+            params = _set_path(params, path, helper.matrix_to_grads(w))
+        return params
+
+    def predictive(
+        self,
+        apply_fn: Callable[[Any, jax.Array], jax.Array],
+        x: jax.Array,
+        key: jax.Array,
+        n_samples: int | None = None,
+    ) -> jax.Array:
+        """Monte-Carlo posterior-predictive class probabilities.
+
+        ``apply_fn(params, x) -> logits``; returns the mean softmax over
+        ``n_samples`` posterior draws (default ``config.n_samples``).
+        """
+        n = int(n_samples if n_samples is not None else self.config.n_samples)
+        keys = jax.random.split(key, n)
+        probs = jax.vmap(
+            lambda k: jax.nn.softmax(apply_fn(self.sample_params(k), x))
+        )(keys)
+        return probs.mean(axis=0)
+
+    def linearized_variance(self, phi: jax.Array) -> jax.Array:
+        """Closed-form last-layer predictive variance of the logits.
+
+        ``phi``: (batch, d_in) inputs TO the covered layer (the
+        penultimate features). A bias column of ones is appended when the
+        layer carries one. Returns (batch, d_out) — the per-logit
+        variance of the linearized Laplace, no sampling involved.
+        """
+        cfg = self.config
+        if cfg.mode != 'last_layer':
+            raise ValueError(
+                'linearized_variance is the closed-form last-layer path; '
+                f"this posterior was exported with mode={cfg.mode!r}"
+            )
+        (name,) = self.layers
+        helper = self.helpers[name]
+        arrs = self.layers[name]
+        if getattr(helper, 'has_bias', False):
+            ones = jnp.ones(phi.shape[:-1] + (1,), phi.dtype)
+            phi = jnp.concatenate([phi, ones], axis=-1)
+        p = jnp.asarray(cfg.prior_precision, phi.dtype)
+        qa = arrs['qa'].astype(phi.dtype)
+        qg = arrs['qg'].astype(phi.dtype)
+        inv_a = 1.0 / (arrs['da'].astype(phi.dtype) + jnp.sqrt(p))
+        inv_g = 1.0 / (arrs['dg'].astype(phi.dtype) + jnp.sqrt(p))
+        # phi~ Sigma_A phi~^T diagonal, through the eigenbasis
+        proj = phi @ qa
+        quad = jnp.sum(proj * proj * inv_a[None, :], axis=-1)
+        diag_g = (qg * qg) @ inv_g
+        return cfg.temperature * quad[:, None] * diag_g[None, :]
+
+
+def load_posterior(path: str | os.PathLike[str]) -> LaplacePosterior:
+    """Load a :func:`kfac_tpu.laplace.export_posterior` artifact."""
+    import orbax.checkpoint as ocp
+
+    path = os.fspath(path)
+    doc_path = os.path.join(path, 'POSTERIOR.json')
+    if not os.path.exists(doc_path):
+        raise ValueError(
+            f'{path!r} holds no POSTERIOR.json — not a posterior artifact '
+            '(or an export died before the doc was finalized)'
+        )
+    with open(doc_path, encoding='utf-8') as f:
+        doc = json.load(f)
+    missing = [k for k in export_lib.POSTERIOR_KEYS if k not in doc]
+    unknown = [k for k in doc if k not in export_lib.POSTERIOR_KEYS]
+    if missing or unknown:
+        raise ValueError(
+            f'malformed posterior document: missing keys {missing}, '
+            f'unknown keys {unknown}'
+        )
+    if doc['schema'] != export_lib.POSTERIOR_SCHEMA_VERSION:
+        raise ValueError(
+            f'posterior schema {doc["schema"]} is not the supported '
+            f'version {export_lib.POSTERIOR_SCHEMA_VERSION}'
+        )
+    cfg = config_lib.LaplaceConfig(**doc['config'])
+
+    ckptr = ocp.StandardCheckpointer()
+    payload = ckptr.restore(os.path.join(os.path.abspath(path), 'arrays'))
+    expected = export_lib.MODE_ARRAYS[cfg.mode]
+    layers: dict[str, dict[str, jax.Array]] = {}
+    helpers: dict[str, helpers_lib.LayerHelper] = {}
+    param_paths: dict[str, tuple[str, ...]] = {}
+    for name, entry in doc['layers'].items():
+        arrs = payload['layers'].get(name, {})
+        absent = [k for k in expected if k not in arrs]
+        if absent:
+            raise ValueError(
+                f'posterior arrays for layer {name!r} are missing {absent} '
+                f"(mode {cfg.mode!r} stores {list(expected)})"
+            )
+        layers[name] = {k: jnp.asarray(arrs[k]) for k in expected}
+        helpers[name] = _helper_from_doc(entry)
+        param_paths[name] = tuple(entry['param_path'])
+    params = jax.tree_util.tree_map(jnp.asarray, payload['params'])
+    return LaplacePosterior(
+        config=cfg,
+        params=params,
+        layers=layers,
+        helpers=helpers,
+        param_paths=param_paths,
+        fingerprint=doc['fingerprint'],
+        meta=doc['meta'],
+    )
+
+
+def fit_prior_precision(
+    posterior: LaplacePosterior,
+    apply_fn: Callable[[Any, jax.Array], jax.Array],
+    data: tuple[jax.Array, jax.Array],
+    key: jax.Array,
+    grid: Any = None,
+    n_samples: int | None = None,
+) -> tuple[LaplacePosterior, dict[float, float]]:
+    """Fit ``prior_precision`` by held-out predictive NLL.
+
+    Evaluates the Monte-Carlo predictive at each candidate precision on
+    ``data = (x, labels)`` — the SAME sampling key per candidate, so the
+    comparison is paired — and returns ``(posterior with the best
+    precision, {candidate: nll})``. Prior precision enters only at
+    sample time, so no re-export is involved.
+    """
+    x, y = data
+    if grid is None:
+        grid = np.logspace(-2.0, 3.0, 11)
+    nlls: dict[float, float] = {}
+    for p in grid:
+        cand = dataclasses.replace(
+            posterior,
+            config=dataclasses.replace(
+                posterior.config, prior_precision=float(p)
+            ),
+        )
+        probs = cand.predictive(apply_fn, x, key, n_samples=n_samples)
+        lp = jnp.log(jnp.clip(probs[jnp.arange(y.shape[0]), y], 1e-12))
+        nlls[float(p)] = float(-jnp.mean(lp))
+    best = min(nlls, key=nlls.get)
+    fitted = dataclasses.replace(
+        posterior,
+        config=dataclasses.replace(posterior.config, prior_precision=best),
+    )
+    return fitted, nlls

@@ -60,6 +60,35 @@ def _make_gtap(helper: helpers_lib.LayerHelper) -> Callable[..., jax.Array]:
     return gtap
 
 
+def _make_role_gtap(
+    helper: helpers_lib.LoRAHelper, role: str
+) -> Callable[..., jax.Array]:
+    """Identity g-tap for one adapter of a fused LoRA unit.
+
+    Its vjp emits the role's G block embedded in the unit's
+    block-diagonal G factor. Both roles' taps share the unit's single
+    zero dummy argument, so their cotangents SUM — the role blocks are
+    pre-scaled by the role count (helpers.LoRAHelper._embed) and the
+    capture's shared invocation counter divides the sum back to the true
+    block-diagonal factor.
+    """
+
+    @jax.custom_vjp
+    def gtap(y: jax.Array, gstat: Any) -> jax.Array:
+        del gstat
+        return y
+
+    def fwd(y: jax.Array, gstat: Any):
+        del gstat
+        return y, None
+
+    def bwd(_, ybar: jax.Array):
+        return ybar, helper.role_g_factor(role, ybar)
+
+    gtap.defvjp(fwd, bwd)
+    return gtap
+
+
 class CurvatureCapture:
     """Wraps a loss function to also emit per-layer curvature statistics.
 
@@ -79,6 +108,13 @@ class CurvatureCapture:
         self._gtaps = {
             name: _make_gtap(helper)
             for name, helper in registry.layers.items()
+            if not isinstance(helper, helpers_lib.LoRAHelper)
+        }
+        # fused units (LoRA adapter pairs) tap at their CHILD module
+        # paths; Registry.taps routes each child to (unit, role)
+        self._role_gtaps = {
+            tap: _make_role_gtap(registry.layers[unit], role)
+            for tap, (unit, role) in registry.taps.items()
         }
 
     def zero_gstats(self) -> dict[str, Any]:
@@ -113,11 +149,30 @@ class CurvatureCapture:
         """
         registry = self.registry
         gtaps = self._gtaps
+        role_gtaps = self._role_gtaps
 
         def wrapped(params: Any, gstats: dict[str, jax.Array], *args: Any, **kwargs: Any):
             a_stats: dict[str, jax.Array] = {}
             counts: dict[str, jax.Array] = {}
             weights: dict[str, jax.Array] = {}
+
+            def role_tap(name, iargs, ikwargs, next_fun):
+                # fused-unit child projection: embed this role's A block
+                # into the unit's block-diagonal accumulator and g-tap the
+                # child output into the unit's shared G dummy (cotangents
+                # of the two roles sum there)
+                unit, role = registry.taps[name]
+                uhelper = registry.layers[unit]
+                a = jax.lax.stop_gradient(iargs[0])
+                a_fac = uhelper.role_a_factor(role, a)
+                if unit in a_stats:
+                    a_stats[unit] = a_stats[unit] + a_fac
+                    counts[unit] = counts[unit] + 1
+                else:
+                    a_stats[unit] = a_fac
+                    counts[unit] = jnp.asarray(1, dtype=jnp.int32)
+                y = next_fun(*iargs, **ikwargs)
+                return role_gtaps[name](y, gstats[unit])
 
             def interceptor(next_fun, iargs, ikwargs, context):
                 mod = context.module
@@ -125,7 +180,13 @@ class CurvatureCapture:
                     return next_fun(*iargs, **ikwargs)
                 name = registry_lib.path_name(mod.path)
                 helper = registry.layers.get(name)
+                if isinstance(helper, helpers_lib.LoRAHelper):
+                    # the unit module itself carries no tap; its children
+                    # (Registry.taps) do
+                    return next_fun(*iargs, **ikwargs)
                 if helper is None:
+                    if name in registry.taps:
+                        return role_tap(name, iargs, ikwargs, next_fun)
                     return next_fun(*iargs, **ikwargs)
                 a = jax.lax.stop_gradient(iargs[0])
                 a_fac = helper.get_a_factor(a)

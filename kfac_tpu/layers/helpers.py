@@ -237,6 +237,108 @@ class Conv2dHelper(LayerHelper):
         return out
 
 
+@dataclasses.dataclass(frozen=True)
+class LoRAHelper(LayerHelper):
+    """Fused helper for a LoRA adapter pair registered as ONE unit.
+
+    A :class:`kfac_tpu.models.lora.LoRADense` computes
+    ``base(x) + up(down(x)) * (alpha/rank)`` with the base projection
+    frozen; K-FAC preconditions the trainable ``down`` (d_in -> rank) and
+    ``up`` (rank -> d_out) kernels jointly as one registered unit with
+    BLOCK-DIAGONAL Kronecker factors::
+
+        A = [[A_down, 0], [0, A_up]]   ((d_in+rank)^2, from x and h)
+        G = [[G_down, 0], [0, G_up]]   ((rank+d_out)^2, from dh and dy)
+
+    Block-diagonal factors invert block-wise, and the packed gradient
+    matrix is block-diagonal too, so the preconditioned result is EXACTLY
+    two-layer K-FAC over the adapters — the cross-adapter covariance
+    blocks are the (documented, zeroed) approximation. Each child module
+    carries its own capture tap (``Registry.taps`` routes it here by
+    role); a role's block arrives pre-scaled by the role count so the
+    capture's shared invocation counter averages back to the true
+    block-diagonal factor. G blocks use the ROUTED normalization
+    (cov.routed_linear_g_factor): at the standard zero-init of the up
+    kernel every down cotangent is identically zero, and the live-row
+    normalization keeps that dead G block at zero (EMA leaves the
+    identity) instead of diluting it with 0/N mass.
+
+    The adapters carry no bias (``has_bias`` is always False); the frozen
+    base bias stays outside the unit entirely.
+    """
+
+    in_features: int = 0
+    rank: int = 0
+    out_features: int = 0
+    factor_dtype: Any = jnp.float32
+
+    ROLES = ('down', 'up')
+
+    def __post_init__(self) -> None:
+        if self.has_bias:
+            raise ValueError(
+                'LoRAHelper has no bias column: adapter projections are '
+                'bias-free and the frozen base bias is not preconditioned'
+            )
+
+    @property
+    def a_factor_shape(self) -> tuple[int, int]:
+        n = self.in_features + self.rank
+        return (n, n)
+
+    @property
+    def g_factor_shape(self) -> tuple[int, int]:
+        n = self.rank + self.out_features
+        return (n, n)
+
+    def _embed(self, block: jax.Array, dim: int, lo: int) -> jax.Array:
+        out = jnp.zeros((dim, dim), dtype=block.dtype)
+        # pre-scale by the role count: the capture accumulator counts each
+        # role tap as one invocation, so the shared divisor (2 per forward
+        # call) averages the embedded blocks back to weight 1 each
+        return out.at[
+            lo : lo + block.shape[0], lo : lo + block.shape[0]
+        ].set(block * len(self.ROLES))
+
+    def role_a_factor(self, role: str, a: jax.Array) -> jax.Array:
+        dim = self.a_factor_shape[0]
+        fac = cov.linear_a_factor(a, has_bias=False, dtype=self.factor_dtype)
+        lo = 0 if role == 'down' else self.in_features
+        return self._embed(fac, dim, lo)
+
+    def role_g_factor(self, role: str, g: jax.Array) -> jax.Array:
+        dim = self.g_factor_shape[0]
+        fac = cov.routed_linear_g_factor(g, dtype=self.factor_dtype)
+        lo = 0 if role == 'down' else self.rank
+        return self._embed(fac, dim, lo)
+
+    def get_a_factor(self, a: jax.Array) -> jax.Array:
+        raise NotImplementedError(
+            'LoRA units capture through per-role taps (Registry.taps), '
+            'not a module-level A tap'
+        )
+
+    def get_g_factor(self, g: jax.Array) -> jax.Array:
+        raise NotImplementedError(
+            'LoRA units capture through per-role taps (Registry.taps), '
+            'not a module-level g-tap'
+        )
+
+    def grads_to_matrix(self, grads: dict[str, Any]) -> jax.Array:
+        r, di, do = self.rank, self.in_features, self.out_features
+        mat = jnp.zeros((r + do, di + r), dtype=grads['down']['kernel'].dtype)
+        mat = mat.at[:r, :di].set(grads['down']['kernel'].T)
+        mat = mat.at[r:, di:].set(grads['up']['kernel'].T)
+        return mat
+
+    def matrix_to_grads(self, mat: jax.Array) -> dict[str, Any]:
+        r, di = self.rank, self.in_features
+        return {
+            'down': {'kernel': mat[:r, :di].T},
+            'up': {'kernel': mat[r:, di:].T},
+        }
+
+
 def matrix_param_count(helper: LayerHelper) -> int:
     """Number of elements in the packed gradient matrix for a helper."""
     return helper.g_factor_shape[0] * helper.a_factor_shape[0]

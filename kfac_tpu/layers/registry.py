@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
+from collections.abc import Mapping
 from typing import Any, Callable, Iterable
 
 import flax.linen as nn
@@ -115,10 +116,18 @@ class Registry:
     ``layers`` maps registry name -> LayerHelper;
     ``param_paths`` maps registry name -> tuple path into the params pytree
     (the module path), used to slice gradients in and out.
+    ``taps`` maps a capture-time module path -> ``(unit_name, role)`` for
+    multi-module registered units (LoRA adapter pairs): the unit itself
+    has no ``__call__`` tap; its child projections do, and each routes its
+    statistics into the unit's block of the fused factors. Empty for
+    ordinary registries, so the capture fast path never consults it.
     """
 
     layers: dict[str, helpers.LayerHelper]
     param_paths: dict[str, tuple[str, ...]]
+    taps: dict[str, tuple[str, str]] = dataclasses.field(
+        default_factory=dict
+    )
 
     def __len__(self) -> int:
         return len(self.layers)
@@ -127,11 +136,97 @@ class Registry:
         return list(self.layers)
 
 
+def _mask_value(mask: Any, path: tuple[str, ...], name: str) -> bool:
+    """Resolve an optax-style trainability mask at one layer's param path.
+
+    The mask is a prefix pytree of bools over the params: a bool at any
+    prefix covers the whole subtree beneath it, and a path the mask does
+    not mention is trainable (``True``) — so ``{'backbone': False}``
+    freezes every backbone layer without spelling out its leaves, exactly
+    like ``optax.masked``'s pytree convention. A layer whose OWN subtree
+    mixes True and False leaves is an error: K-FAC preconditions the
+    layer's kernel+bias jointly, so per-leaf splits inside one layer have
+    no factor-level meaning.
+    """
+    node = mask
+    for key in path:
+        if isinstance(node, bool):
+            return node
+        if not isinstance(node, Mapping):
+            raise TypeError(
+                f'mask node at a prefix of layer {name!r} is '
+                f'{type(node).__name__}; expected a bool or a mapping '
+                '(optax-style prefix pytree of bools)'
+            )
+        if key not in node:
+            return True
+        node = node[key]
+    if isinstance(node, bool):
+        return node
+    leaves = jax.tree_util.tree_leaves(node)
+    if not leaves:
+        return True
+    values = {bool(v) for v in leaves}
+    if len(values) > 1:
+        raise ValueError(
+            f'mask splits layer {name!r} into trainable and frozen '
+            'leaves; K-FAC preconditions a layer jointly, so mask whole '
+            'layers (a bool at the layer path or a uniform subtree)'
+        )
+    return values.pop()
+
+
+def masked_registry(registry: Registry, mask: Any) -> Registry:
+    """Registry with mask-frozen layers removed (``mask=None`` is identity).
+
+    This is THE mask mechanism: every downstream consumer — capture taps,
+    engine factor state, KAISA bucketing/assignment, the autotune cost
+    model, metrics keys, checkpoints, ``describe()`` — keys off
+    ``registry.layers``, and unregistered parameters already pass through
+    the preconditioner untouched, so dropping a layer here excludes it
+    everywhere at once (the reference's frozen-parameter skip,
+    kfac/layers/register.py:31-33). LoRA units resolve the mask at their
+    adapter paths (``down``/``up``); the ``base`` projection inside a
+    unit is never preconditioned, so freezing it does not freeze the
+    unit, but the two adapters must agree.
+    """
+    if mask is None:
+        return registry
+    keep: dict[str, helpers.LayerHelper] = {}
+    paths: dict[str, tuple[str, ...]] = {}
+    for name, helper in registry.layers.items():
+        path = registry.param_paths[name]
+        if isinstance(helper, helpers.LoRAHelper):
+            roles = {
+                role: _mask_value(mask, path + (role,), name)
+                for role in ('down', 'up')
+            }
+            if len(set(roles.values())) > 1:
+                raise ValueError(
+                    f'mask freezes one adapter of LoRA unit {name!r} but '
+                    f'not the other ({roles}); the pair preconditions as '
+                    'one unit, so mask both the same way'
+                )
+            trainable = roles['down']
+        else:
+            trainable = _mask_value(mask, path, name)
+        if trainable:
+            keep[name] = helper
+            paths[name] = path
+    taps = {
+        tap: (unit, role)
+        for tap, (unit, role) in registry.taps.items()
+        if unit in keep
+    }
+    return Registry(layers=keep, param_paths=paths, taps=taps)
+
+
 def register_model(
     model: nn.Module,
     *args: Any,
     skip_layers: list[str] | None = None,
     routed_layers: list[str] | None = None,
+    mask: Any = None,
     factor_dtype: Any = jnp.float32,
     apply_fn: Callable[..., Any] | None = None,
     **kwargs: Any,
@@ -150,11 +245,27 @@ def register_model(
     captured statistics EXACTLY the per-expert oracle instead of the
     routed-fraction-scaled approximation (e.g.
     ``routed_layers=[r'.*expert\\d+_(up|down)']`` for ``models/moe.py``).
+
+    ``mask`` is an optax-style trainability pytree of bools over the
+    params (prefix semantics: a bool at any prefix covers its subtree,
+    unmentioned paths are trainable): layers whose params the mask
+    freezes are dropped from the registry, so they get no capture taps,
+    no factors, no engine slots, and their gradients pass through the
+    preconditioner untouched — see :func:`masked_registry`.
+
+    Modules declaring ``_kfac_lora_unit = True``
+    (:class:`kfac_tpu.models.lora.LoRADense`) register as ONE unit: the
+    adapter pair's factors are block-diagonal in a single fused helper
+    (:class:`kfac_tpu.layers.helpers.LoRAHelper`), their child taps
+    recorded in ``Registry.taps``; the frozen ``base`` projection and any
+    modules nested under a unit are not registered separately.
     """
     skip_patterns = [re.compile(p) for p in (skip_layers or [])]
     routed_patterns = [re.compile(p) for p in (routed_layers or [])]
     found: dict[str, helpers.LayerHelper] = {}
     param_paths: dict[str, tuple[str, ...]] = {}
+    taps: dict[str, tuple[str, str]] = {}
+    unit_prefixes: list[tuple[str, ...]] = []
 
     def interceptor(next_fun, iargs, ikwargs, context):
         mod = context.module
@@ -166,6 +277,27 @@ def register_model(
         name = path_name(mod.path)
         cls_name = type(mod).__name__.lower()
         if any_match(name, skip_patterns) or any_match(cls_name, skip_patterns):
+            return next_fun(*iargs, **ikwargs)
+        path = tuple(mod.path)
+        if getattr(type(mod), '_kfac_lora_unit', False):
+            if name not in found:
+                found[name] = helpers.LoRAHelper(
+                    name=name,
+                    has_bias=False,
+                    in_features=int(x.shape[-1]),
+                    rank=int(mod.rank),
+                    out_features=int(mod.features),
+                    factor_dtype=factor_dtype,
+                )
+                param_paths[name] = path
+                taps[f'{name}/down'] = (name, 'down')
+                taps[f'{name}/up'] = (name, 'up')
+                unit_prefixes.append(path)
+            return next_fun(*iargs, **ikwargs)
+        if any(path[: len(p)] == p for p in unit_prefixes):
+            # children of a registered unit (base/down/up projections)
+            # belong to the unit's fused helper, never to the registry
+            # directly
             return next_fun(*iargs, **ikwargs)
         helper = make_helper(mod, name, tuple(x.shape), factor_dtype)
         if helper is not None and name not in found:
@@ -215,7 +347,12 @@ def register_model(
                 'the approximate shared-normalization capture, so it is an '
                 f'error. Registered layers: {sorted(found)}'
             )
-    return Registry(layers=dict(found), param_paths=dict(param_paths))
+    registry = Registry(
+        layers=dict(found),
+        param_paths=dict(param_paths),
+        taps=dict(taps),
+    )
+    return masked_registry(registry, mask)
 
 
 def slice_layer_grads(
@@ -261,6 +398,7 @@ def merge_registries(*registries: Registry) -> Registry:
     EP block a distinct ``name_prefix``."""
     layers: dict[str, helpers.LayerHelper] = {}
     paths: dict[str, tuple[str, ...]] = {}
+    taps: dict[str, tuple[str, str]] = {}
     for r in registries:
         overlap = set(layers) & set(r.layers)
         if overlap:
@@ -269,4 +407,5 @@ def merge_registries(*registries: Registry) -> Registry:
             )
         layers.update(r.layers)
         paths.update(r.param_paths)
-    return Registry(layers=layers, param_paths=paths)
+        taps.update(r.taps)
+    return Registry(layers=layers, param_paths=paths, taps=taps)

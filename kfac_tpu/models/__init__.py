@@ -1,5 +1,6 @@
 """Model families: MLP, CIFAR/ImageNet ResNets, Transformer LM, MoE."""
 
+from kfac_tpu.models.lora import LoRADense
 from kfac_tpu.models.mlp import MLP
 from kfac_tpu.models.resnet import (
     CifarResNet,
@@ -13,6 +14,7 @@ from kfac_tpu.models.moe import MoEMLP, expert_tp_overrides, load_balance_loss
 from kfac_tpu.models.transformer import TransformerLM, lm_loss
 
 __all__ = [
+    'LoRADense',
     'MLP',
     'MoEMLP',
     'CifarResNet',

@@ -1,0 +1,56 @@
+"""LoRA-style adapter modules for second-order fine-tuning.
+
+A :class:`LoRADense` wraps a (frozen) base projection with a trainable
+low-rank update ``base(x) + up(down(x)) * (alpha/rank)`` (Hu et al. 2021).
+The class attribute ``_kfac_lora_unit`` marks it for
+:func:`kfac_tpu.register_model`, which fuses the adapter pair into ONE
+registered unit with block-diagonal Kronecker factors
+(:class:`kfac_tpu.layers.helpers.LoRAHelper`) — one factor slot, one
+KAISA assignment entry, one bucket slice for the pair — while the base
+projection stays unregistered (freeze it with the trainability ``mask``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+
+
+class LoRADense(nn.Module):
+    """Dense layer with a low-rank trainable adapter.
+
+    Attributes:
+        features: output width (the base projection's, and ``up``'s).
+        rank: adapter bottleneck width; the trainable parameter count is
+            ``rank * (d_in + features)``.
+        alpha: LoRA scaling numerator; the update is scaled by
+            ``alpha / rank`` so tuning ``rank`` does not retune the
+            effective learning rate (the standard parameterization).
+        use_bias: whether the base projection carries a bias (frozen with
+            the rest of the base).
+
+    The ``up`` kernel initializes to zero, so at init the module computes
+    exactly ``base(x)`` — fine-tuning starts from the pretrained
+    function. ``down`` uses the default LeCun-normal init.
+    """
+
+    features: int
+    rank: int = 8
+    alpha: float = 16.0
+    use_bias: bool = True
+
+    # Registration marker consumed by kfac_tpu.layers.registry (duck-typed
+    # so the registry never imports model code).
+    _kfac_lora_unit = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = nn.Dense(self.features, use_bias=self.use_bias, name='base')(x)
+        h = nn.Dense(self.rank, use_bias=False, name='down')(x)
+        delta = nn.Dense(
+            self.features,
+            use_bias=False,
+            name='up',
+            kernel_init=nn.initializers.zeros_init(),
+        )(h)
+        return y + delta * (self.alpha / self.rank)

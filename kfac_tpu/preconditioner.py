@@ -162,6 +162,17 @@ class KFACPreconditioner:
     IR_STEP_PATH = ('step',)
 
     registry: registry_lib.Registry
+    # Optax-style trainability mask over the model params (prefix pytree
+    # of bools; True = trainable, unmentioned paths trainable). Frozen
+    # layers are dropped from the registry at construction
+    # (registry.masked_registry): no capture taps, no factor state, no
+    # KAISA bucket/assignment slots, no metrics keys — and their
+    # gradients pass through precondition() untouched (unregistered
+    # parameters already do). None (the default) touches nothing: the
+    # registry is used exactly as given, bit-identical to a maskless
+    # config. The distributed engine inherits the masked registry through
+    # config.registry.
+    mask: Any = None
     factor_update_steps: int | Callable[[jax.Array], jax.Array] = 1
     inv_update_steps: int | Callable[[jax.Array], jax.Array] = 1
     damping: ScalarOrSchedule = 0.001
@@ -308,6 +319,13 @@ class KFACPreconditioner:
     offload: 'compression_config_lib.OffloadConfig | int | bool | None' = None
 
     def __post_init__(self) -> None:
+        if self.mask is not None:
+            # drop mask-frozen layers up front so EVERY registry consumer
+            # (engine state, capture, KAISA assignment via config.registry,
+            # metrics, checkpoints) sees only trainable layers
+            self.registry = registry_lib.masked_registry(
+                self.registry, self.mask
+            )
         if self.metrics is True:
             self.metrics = metrics_lib.MetricsConfig()
         elif self.metrics is False:
@@ -1090,6 +1108,11 @@ class KFACPreconditioner:
             f'layers, compute_method={self.compute_method.name}, '
             f'inverse_solver={self.inverse_solver}',
         ]
+        if self.mask is not None:
+            lines.append(
+                '  mask: trainability mask active — frozen layers are '
+                'unregistered (no factors, gradients pass through)'
+            )
         if self.health is not None:
             hc = self.health
             lines.append(

@@ -1,9 +1,15 @@
 """Dynamic loss scaling: scaler semantics + the fp16 end-to-end flow
-(reference examples/vision/engine.py:80-88 torch.cuda.amp parity)."""
+(reference examples/vision/engine.py:80-88 torch.cuda.amp parity).
+
+The end-to-end recovery run is slow-marked: fp16 matmuls are software-
+emulated on CPU (~8 s/step), so the 40-step flow costs minutes while the
+scaler semantics it rides on are pinned by the fast unit tests above it.
+"""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kfac_tpu import amp
 
@@ -34,6 +40,7 @@ def test_all_finite_and_unscale():
     np.testing.assert_allclose(np.asarray(un['g']), [2.0, 2.0])
 
 
+@pytest.mark.slow
 def test_amp_training_recovers_from_real_overflow():
     """examples/train_amp.py end to end on a tiny config with an absurd
     initial scale: fp16 cotangents MUST overflow (scale * O(0.1) >> 65504),

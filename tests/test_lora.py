@@ -1,0 +1,177 @@
+"""LoRA-unit K-FAC tests (kfac_tpu/models/lora.py + layers.LoRAHelper).
+
+The unit contract: a ``LoRADense`` registers as ONE fused unit with
+block-diagonal Kronecker factors over its adapter pair, captured through
+per-role taps (``Registry.taps``). Block-diagonal factors invert
+block-wise and the packed gradient is block-diagonal too, so the unit's
+preconditioned result must be EXACTLY two-layer K-FAC over the adapters —
+that equivalence is tested in closed form below.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import kfac_tpu
+from kfac_tpu import training
+from kfac_tpu.layers import helpers as helpers_lib
+from kfac_tpu.models import LoRADense
+from kfac_tpu.ops import cov
+
+D_IN, RANK, D_OUT = 6, 2, 4
+
+
+class OneUnit(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return LoRADense(features=D_OUT, rank=RANK, name='lora')(x)
+
+
+@pytest.fixture(scope='module')
+def unit():
+    """One registered LoRA unit shared module-wide: registration tracing
+    and the capture compile are the costly part, and no test mutates it."""
+    m = OneUnit()
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, D_IN))
+    y = jax.random.normal(jax.random.PRNGKey(2), (16, D_OUT))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+
+    def loss_fn(p, b):
+        xx, yy = b
+        return jnp.mean((m.apply({'params': p}, xx) - yy) ** 2)
+
+    return m, params, (x, y), reg, loss_fn
+
+
+def test_unit_registration(unit):
+    _, params, _, reg, _ = unit
+    assert sorted(reg.layers) == ['lora']
+    h = reg.layers['lora']
+    assert isinstance(h, helpers_lib.LoRAHelper)
+    assert h.a_factor_shape == (D_IN + RANK, D_IN + RANK)
+    assert h.g_factor_shape == (RANK + D_OUT, RANK + D_OUT)
+    assert reg.taps == {'lora/down': ('lora', 'down'), 'lora/up': ('lora', 'up')}
+    # base/down/up children are the unit's, never registered separately
+    assert sorted(params['lora']) == ['base', 'down', 'up']
+    # at zero-init of up, the module computes exactly base(x)
+    m = OneUnit()
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, D_IN))
+    full = m.apply({'params': params}, x)
+    base_only = (
+        x @ params['lora']['base']['kernel'] + params['lora']['base']['bias']
+    )
+    np.testing.assert_allclose(full, base_only, rtol=1e-6)
+
+
+def test_captured_factors_are_block_diagonal(unit):
+    _, params, batch, reg, loss_fn = unit
+    cap = kfac_tpu.CurvatureCapture(reg)
+    _, _, stats = cap.value_stats_and_grad(loss_fn)(params, batch)
+    a = np.asarray(stats.a['lora'])
+    g = np.asarray(stats.g['lora'])
+    # cross-adapter covariance blocks are the documented, zeroed approx
+    np.testing.assert_array_equal(a[:D_IN, D_IN:], 0)
+    np.testing.assert_array_equal(a[D_IN:, :D_IN], 0)
+    np.testing.assert_array_equal(g[:RANK, RANK:], 0)
+    np.testing.assert_array_equal(g[RANK:, :RANK], 0)
+    # the down block of A is the plain dense A factor of the unit's input
+    x = batch[0]
+    expected = np.asarray(cov.linear_a_factor(x, has_bias=False))
+    np.testing.assert_allclose(a[:D_IN, :D_IN], expected, rtol=1e-5, atol=1e-6)
+    # up's input is down(x)
+    h = x @ params['lora']['down']['kernel']
+    expected_up = np.asarray(cov.linear_a_factor(h, has_bias=False))
+    np.testing.assert_allclose(a[D_IN:, D_IN:], expected_up, rtol=1e-5, atol=1e-6)
+    # zero-init up kernel: every down cotangent is identically zero, and
+    # the routed normalization keeps that dead G block exactly zero
+    np.testing.assert_array_equal(g[:RANK, :RANK], 0)
+    assert float(np.abs(g[RANK:, RANK:]).max()) > 0
+
+
+def test_unit_preconditioning_equals_two_layer_kfac():
+    """Closed form: block-diag factor solve == per-adapter dense solves."""
+    rng = np.random.default_rng(0)
+
+    def spd(n):
+        m = rng.standard_normal((n, n))
+        return m @ m.T + n * np.eye(n)
+
+    a_down, a_up = spd(D_IN), spd(RANK)
+    g_down, g_up = spd(RANK), spd(D_OUT)
+    w_down = rng.standard_normal((RANK, D_IN))   # packed (out, in) form
+    w_up = rng.standard_normal((D_OUT, RANK))
+    damping = 0.1
+
+    h = helpers_lib.LoRAHelper(
+        name='lora', has_bias=False,
+        in_features=D_IN, rank=RANK, out_features=D_OUT,
+    )
+    grads = {
+        'down': {'kernel': jnp.asarray(w_down.T)},
+        'up': {'kernel': jnp.asarray(w_up.T)},
+    }
+    mat = np.asarray(h.grads_to_matrix(grads))
+    a = np.zeros((D_IN + RANK,) * 2)
+    a[:D_IN, :D_IN], a[D_IN:, D_IN:] = a_down, a_up
+    g = np.zeros((RANK + D_OUT,) * 2)
+    g[:RANK, :RANK], g[RANK:, RANK:] = g_down, g_up
+
+    def solve(gf, wf, af):
+        lam = np.sqrt(damping)
+        gi = np.linalg.inv(gf + lam * np.eye(len(gf)))
+        ai = np.linalg.inv(af + lam * np.eye(len(af)))
+        return gi @ wf @ ai
+
+    unit = solve(g, mat, a)
+    out = h.matrix_to_grads(jnp.asarray(unit))
+    # the helper packs through jnp float32; the reference solves run in
+    # float64 — compare at float32 precision
+    np.testing.assert_allclose(
+        np.asarray(out['down']['kernel']).T, solve(g_down, w_down, a_down),
+        rtol=1e-4, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out['up']['kernel']).T, solve(g_up, w_up, a_up),
+        rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_lora_training_decreases_loss(unit):
+    """Frozen-base LoRA fine-tune through the Trainer: the full routed
+    capture -> block factors -> precondition -> mask pipeline."""
+    m, params, (x, y), _, _ = unit
+    mask = {'lora': {'base': False}}
+    reg = kfac_tpu.register_model(m, x, mask=mask)
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg, lr=0.1, damping=1e-3,
+        factor_update_steps=1, inv_update_steps=5,
+    )
+    labels = jax.tree_util.tree_map_with_path(
+        lambda path, _: 'frozen'
+        if 'base' in [getattr(k, 'key', '') for k in path]
+        else 'train',
+        params,
+    )
+    optimizer = optax.multi_transform(
+        {'train': optax.sgd(0.1), 'frozen': optax.set_to_zero()}, labels
+    )
+
+    def loss_fn(p, ms, b):
+        xx, yy = b
+        return jnp.mean((m.apply({'params': p}, xx) - yy) ** 2), ms
+
+    tr = training.Trainer(loss_fn=loss_fn, optimizer=optimizer, kfac=kfac)
+    st = tr.init(params, None)
+    st, first = tr.step(st, (x, y))
+    for _ in range(19):
+        st, last = tr.step(st, (x, y))
+    assert float(last) < float(first)
+    # the frozen base never moved; the adapters did
+    np.testing.assert_array_equal(
+        st.params['lora']['base']['kernel'], params['lora']['base']['kernel']
+    )
+    assert float(jnp.abs(st.params['lora']['up']['kernel']).max()) > 0

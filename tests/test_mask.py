@@ -1,0 +1,143 @@
+"""Trainability-mask tests: a frozen layer vanishes from every surface.
+
+The contract (docs/ARCHITECTURE.md "Trainability masks"): masking IS
+registry removal — a mask-frozen layer gets no capture taps, no factor
+state, no engine slots, no metrics keys, and its gradients pass through
+the preconditioner bit-identically. ``mask=None`` is pinned as the exact
+identity so existing configs cannot drift.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import kfac_tpu
+from kfac_tpu import health as health_lib
+from kfac_tpu.layers import registry as registry_lib
+from kfac_tpu.models import LoRADense
+from kfac_tpu.observability import metrics as metrics_lib
+from testing import models
+
+
+def _setup():
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=32, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    return m, params, (x, y), reg, models.mse_loss(m)
+
+
+def _pgrads(reg, params, batch, loss_fn, **kw):
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg, kl_clip=None, **kw)
+    cap = kfac_tpu.CurvatureCapture(kfac.registry)
+    _, grads, stats = cap.value_stats_and_grad(loss_fn)(params, batch)
+    state = kfac.init()
+    state, pgrads = kfac.step(state, grads, stats)
+    return kfac, state, grads, pgrads
+
+
+def test_mask_none_is_identity():
+    _, params, batch, reg, loss_fn = _setup()
+    assert registry_lib.masked_registry(reg, None) is reg
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg, mask=None)
+    assert kfac.registry is reg
+    # and the preconditioned gradients are pinned bit-identical
+    _, _, _, base = _pgrads(reg, params, batch, loss_fn)
+    _, _, _, masked = _pgrads(reg, params, batch, loss_fn, mask=None)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, base, masked)
+
+
+def test_frozen_layer_dropped_everywhere():
+    _, params, batch, reg, loss_fn = _setup()
+    mask = {'fc2': False}
+    kfac, state, grads, pgrads = _pgrads(
+        reg, params, batch, loss_fn, mask=mask,
+        health=health_lib.HealthConfig(warn=False),
+        metrics=kfac_tpu.MetricsConfig(),
+    )
+    # registry: dropped, taps and all
+    assert sorted(kfac.registry.layers) == ['fc1']
+    # factor state: no slot at all, not an untouched identity
+    assert 'fc2' not in state.a and 'fc2' not in state.g
+    # health + metrics schemas: keyed off the masked registry
+    assert all('fc2' not in k for k in state.health.quarantined)
+    names = list(kfac.registry.layers)
+    for key in metrics_lib.metric_keys(kfac.metrics, names):
+        assert 'fc2' not in key
+    for key in health_lib.health_metric_keys(names):
+        assert 'fc2' not in key
+    # gradients: frozen layer's pass through bit-identically
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal, grads['fc2'], pgrads['fc2']
+    )
+    # ...while the trainable layer is actually preconditioned
+    assert float(jnp.abs(pgrads['fc1']['kernel'] - grads['fc1']['kernel']).max()) > 0
+
+
+def test_mask_matches_skip_layers_exactly():
+    """mask-frozen and never-registered produce the same preconditioning."""
+    m, params, batch, reg, loss_fn = _setup()
+    _, _, _, via_mask = _pgrads(reg, params, batch, loss_fn, mask={'fc2': False})
+    reg_skip = kfac_tpu.register_model(m, batch[0], skip_layers=['fc2'])
+    _, _, _, via_skip = _pgrads(reg_skip, params, batch, loss_fn)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, via_mask, via_skip)
+
+
+def test_register_model_mask_kwarg_equals_masked_registry():
+    m, _, batch, reg, _ = _setup()
+    mask = {'fc1': False}
+    direct = kfac_tpu.register_model(m, batch[0], mask=mask)
+    wrapped = registry_lib.masked_registry(reg, mask)
+    assert sorted(direct.layers) == sorted(wrapped.layers) == ['fc2']
+    assert direct.param_paths == wrapped.param_paths
+
+
+def test_mask_prefix_semantics():
+    _, _, batch, reg, _ = _setup()
+    # a bool at a prefix covers the subtree; unmentioned paths stay
+    assert sorted(registry_lib.masked_registry(reg, {'fc1': False}).layers) == ['fc2']
+    # a uniform-leaf subtree works like the covering bool
+    masked = registry_lib.masked_registry(
+        reg, {'fc1': {'kernel': False, 'bias': False}}
+    )
+    assert sorted(masked.layers) == ['fc2']
+    # freezing everything is legal at the registry level (the engine
+    # refuses an empty registry elsewhere)
+    assert registry_lib.masked_registry(reg, False).layers == {}
+
+
+def test_mask_splitting_a_layer_raises():
+    _, _, _, reg, _ = _setup()
+    with pytest.raises(ValueError, match='splits layer'):
+        registry_lib.masked_registry(
+            reg, {'fc1': {'kernel': False, 'bias': True}}
+        )
+
+
+def test_mask_bad_node_type_raises():
+    _, _, _, reg, _ = _setup()
+    with pytest.raises(TypeError, match='expected a bool or a mapping'):
+        registry_lib.masked_registry(reg, 0.5)
+
+
+def test_lora_unit_adapters_must_agree():
+    class M(models.nn.Module):
+        @models.nn.compact
+        def __call__(self, x):
+            return LoRADense(features=4, rank=2, name='lora')(x)
+
+    m = M()
+    x = jnp.ones((4, 6))
+    reg = kfac_tpu.register_model(m, x)
+    with pytest.raises(ValueError, match='one adapter'):
+        registry_lib.masked_registry(reg, {'lora': {'down': False}})
+    # freezing the (never-registered) base does NOT freeze the unit
+    kept = registry_lib.masked_registry(reg, {'lora': {'base': False}})
+    assert sorted(kept.layers) == ['lora']
+    assert sorted(kept.taps) == ['lora/down', 'lora/up']
+    # freezing both adapters drops the unit and its taps together
+    dropped = registry_lib.masked_registry(
+        reg, {'lora': {'down': False, 'up': False}}
+    )
+    assert dropped.layers == {} and dropped.taps == {}
