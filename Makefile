@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults async compress fleet obs tune resilience lint lint-ir inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults async compress fleet obs tune resilience lint lint-ir lint-pod inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -77,14 +77,24 @@ obs: async lint compress fleet
 lint-ir:
 	$(TEST_ENV) $(PY) tools/kfaclint.py --ir --smoke
 
+# kfaclint pod tier alone (KFL301-KFL305): cross-rank SPMD protocol
+# verification — rank-forking abstract interpretation plus the
+# protocol-table model check (see docs/ANALYSIS.md "Pod tier")
+lint-pod:
+	$(TEST_ENV) $(PY) tools/kfaclint.py --pod
+
 # kfaclint: AST rules (KFL001-KFL005) + docs-vs-code drift rules
-# (KFL100-KFL105) + IR rules (KFL201-KFL205, smoke profile) + the
-# analyzer's own fixture selftest and test suites (see docs/ANALYSIS.md)
-lint: lint-ir
-	$(TEST_ENV) $(PY) tools/kfaclint.py --all --smoke
+# (KFL100-KFL105) + IR rules (KFL201-KFL205, smoke profile) + pod rules
+# (KFL301-KFL305) + the analyzer's own fixture selftest and test suites
+# (see docs/ANALYSIS.md). The --all pass runs under `timeout` as a
+# wall-clock budget assertion: every tier together must stay a
+# pre-commit-sized check, not a test suite
+lint: lint-ir lint-pod
+	$(TEST_ENV) timeout -k 10 300 $(PY) tools/kfaclint.py --all --smoke
 	$(TEST_ENV) $(PY) tools/kfaclint.py --selftest
 	$(TEST_ENV) $(PY) -m pytest tests/test_kfaclint.py \
-		tests/test_kfaclint_ir.py -q -m 'not slow'
+		tests/test_kfaclint_ir.py tests/test_kfaclint_pod.py \
+		-q -m 'not slow'
 
 # layout autotuner: test suite, the plan-schema doc lint, and the
 # end-to-end kfac_tune pipeline selftest (see docs/AUTOTUNE.md)

@@ -5,15 +5,18 @@ lives at ``tools/kfaclint.py``. Importing this package populates the
 rule registry (the rule modules register on import).
 
 The AST rules (KFL001–KFL005) need only the stdlib; the drift rules
-(KFL100–KFL107) import live ``kfac_tpu`` modules at *check* time, and
-the IR rules (KFL201–KFL205, ``analysis/ir/``) trace the engines at
-*check* time — not at import time, so ``from kfac_tpu import analysis``
-stays cheap.
+(KFL100–KFL107) import live ``kfac_tpu`` modules at *check* time; the
+IR rules (KFL201–KFL205, ``analysis/ir/``) trace the engines at *check*
+time — not at import time, so ``from kfac_tpu import analysis`` stays
+cheap; and the pod rules (KFL301–KFL305, ``analysis/pod/``) abstractly
+interpret the host control code across virtual ranks, stdlib-only like
+the AST tier.
 """
 
 from kfac_tpu.analysis import (  # noqa: F401  (imported for registration)
     drift,
     ir,
+    pod,
     rules_jit,
     rules_pytree,
     rules_spmd,
@@ -41,3 +44,4 @@ PROJECT_RULE_CODES = (
     'KFL107',
 )
 IR_RULE_CODES = ('KFL201', 'KFL202', 'KFL203', 'KFL204', 'KFL205')
+POD_RULE_CODES = ('KFL301', 'KFL302', 'KFL303', 'KFL304', 'KFL305')

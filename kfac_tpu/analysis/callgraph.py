@@ -313,6 +313,33 @@ class CallGraph:
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
                 yield from self._arg_edges(info, arg)
 
+    def edges_of(self, info: FuncInfo) -> list[FuncInfo]:
+        """Resolved callees (direct calls + function-valued arguments) of
+        one function — the public face of ``_edges`` for the pod tier."""
+        return list(self._edges(info))
+
+    def reverse_edges(self) -> dict[int, list[FuncInfo]]:
+        """{id(callee node): [callers]} over every indexed function.
+
+        The pod tier's happens-before check (KFL304) walks this backward
+        from a rank-divergent mutation to its root callers, then forward
+        again asking whether every root's reach carries a protocol
+        ordering op. Lambdas handed as call arguments become caller-side
+        graph nodes exactly as in :meth:`reachable_from_entries`, so a
+        ``_with_retries(lambda: shutil.rmtree(...))`` chain stays
+        connected.
+        """
+        out: dict[int, list[FuncInfo]] = {}
+        seen: dict[int, set[int]] = {}
+        infos = list(self.functions.values())
+        for info in infos:
+            for callee in self._edges(info):
+                if id(info.node) in seen.setdefault(id(callee.node), set()):
+                    continue
+                seen[id(callee.node)].add(id(info.node))
+                out.setdefault(id(callee.node), []).append(info)
+        return out
+
     def reachable_from_entries(self) -> dict[int, tuple[FuncInfo, str]]:
         """{id(fn node): (FuncInfo, entry display name that reaches it)}."""
         reached: dict[int, tuple[FuncInfo, str]] = {}

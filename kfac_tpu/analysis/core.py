@@ -267,10 +267,12 @@ def load_project(
 class Rule:
     """One registered check.
 
-    ``check`` receives the :class:`Project` for ``kind='ast'`` rules and
-    no arguments for ``kind='project'`` rules (the migrated drift
-    linters, which import the live code). ``what``/``why``/``how`` feed
-    the docs/ANALYSIS.md rule table and its drift guard (KFL100).
+    ``check`` receives the :class:`Project` for ``kind='ast'`` and
+    ``kind='pod'`` rules (both judge source without importing it — pod
+    rules additionally reason across virtual ranks) and no arguments
+    for ``kind='project'`` rules (the migrated drift linters, which
+    import the live code). ``what``/``why``/``how`` feed the
+    docs/ANALYSIS.md rule table and its drift guard (KFL100).
     """
 
     code: str
@@ -281,7 +283,7 @@ class Rule:
     kind: str = 'ast'
 
     def run(self, project: Project | None) -> list[Finding]:
-        if self.kind == 'ast':
+        if self.kind in ('ast', 'pod'):
             assert project is not None
             return self.check(project)
         return self.check()

@@ -1,0 +1,11 @@
+"""Pod tier of kfaclint: cross-rank SPMD protocol verification.
+
+Abstractly interprets the host-side control code across virtual ranks
+(rank 0 plus one generic peer), extracts per-rank ordered traces of
+protocol operations, and model-checks the declared coordination
+protocol tables — rules KFL301–KFL305. Stdlib-only, like the AST tier:
+nothing here imports the code under analysis.
+"""
+
+from kfac_tpu.analysis.pod import rules as _rules  # noqa: F401  (registers)
+from kfac_tpu.analysis.pod import interleave, protocol  # noqa: F401

@@ -1,0 +1,782 @@
+"""Pod tier, stage 1: rank-forking abstract interpretation of host code.
+
+The AST tier judges one function at a time and the IR tier judges one
+rank's lowered program; neither sees the *agreement between ranks* that
+the multihost protocol lives on. This module extends the callgraph with
+rank-condition tracking and extracts, per virtual rank, the ordered
+trace of protocol operations each host-side function performs.
+
+Rank model — two virtual ranks:
+
+- ``'0'`` is process 0 (the single writer of shared filesystem state);
+- ``'p'`` is one generic peer standing for *every* nonzero rank.
+
+``process_count() > 1`` is modeled as True (the pod tier verifies the
+multi-host protocol; single-host degenerations are the runtime's
+``if process_count() == 1: return`` fast paths, which are *uniform*
+branches here). An ``if`` whose test depends on ``process_index()`` —
+directly, or through a tainted local — forks the per-rank paths:
+
+- **exact** rank tests (``process_index() == 0``, ``!= 0``, a bare
+  truthiness test, ``and``-conjunctions of such) partition the ranks
+  between the arms, and an arm that only exits (``return``/``raise``)
+  narrows the active ranks for the rest of the function (form B of the
+  KFL002 guard grammar);
+- **inexact** rank tests (``process_index() == 0 and
+  os.path.exists(p)``) bound which ranks *may* enter the arm without
+  proving anyone does — mutations inside inherit the bound, but no
+  narrowing survives the branch (the unknown conjunct may be False
+  everywhere), which is what keeps single-writer-by-design patterns
+  like the flight recorder's rank-0 postmortem bundle out of the
+  findings;
+- **opaque** rank dependence (a tainted name, an unsupported shape)
+  flags any collective in either arm — a collective whose reachability
+  the analyzer cannot prove uniform is exactly the deadlock class
+  KFL302 exists for.
+
+Protocol ops are matched by call-name last segment against the registry
+that ``kfac_tpu/parallel/multihost.py`` declares as the
+``PROTOCOL_OPS`` literal — parsed here *from the AST* (this tier never
+imports the code it judges, the same guarantee the AST tier gives), and
+falling back to a built-in copy when the module is outside the analyzed
+target set (rule fixtures). Filesystem mutations reuse the KFL002
+grammar, and calls resolving to jit entry points (the callgraph's entry
+detection) become ``launch`` events for KFL303.
+
+Cross-function ordering (KFL304, and the proof that retires KFL002's
+cross-function suppressions) is a happens-before argument: a
+rank-divergent mutation is safe when *every* root of the call chains
+reaching it (functions with no analyzed callers — the protocol's entry
+contexts) also reaches a protocol ordering op (barrier / collective /
+vote / ``wait_until_finished``), because that op is what sequences the
+mutation against the peers no matter which context ran it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import weakref
+from collections import Counter
+
+from kfac_tpu.analysis import callgraph as callgraph_lib
+from kfac_tpu.analysis import core
+from kfac_tpu.analysis import rules_spmd
+
+RANK0 = '0'
+RANKP = 'p'
+ALL_RANKS = frozenset((RANK0, RANKP))
+
+#: fallback copy of kfac_tpu/parallel/multihost.py::PROTOCOL_OPS, used
+#: when that module is not in the analyzed target set (rule fixtures);
+#: when it is, the literal parsed from its AST takes precedence
+DEFAULT_PROTOCOL_OPS: dict[str, str] = {
+    'barrier': 'barrier',
+    'sync_global_devices': 'barrier',
+    'allgather_scalars': 'collective',
+    'process_allgather': 'collective',
+    'agree_emergency': 'collective',
+    'assert_same_step': 'collective',
+    'agree_decision': 'vote',
+    'wait_until_finished': 'wait',
+}
+
+#: op kinds where every participating rank blocks until the others
+#: arrive — reachable by a proper subset of ranks means deadlock
+BLOCKING_KINDS = frozenset({'barrier', 'collective', 'vote'})
+
+#: op kinds that order a rank-divergent mutation against the peers
+ORDERING_KINDS = frozenset({'barrier', 'collective', 'vote', 'wait'})
+
+_RANK_FUNCS = frozenset({'process_index'})
+
+#: bound on transitive inlining of callee mutation summaries
+MAX_INLINE_DEPTH = 4
+
+
+@dataclasses.dataclass
+class OpEvent:
+    """One protocol-relevant operation in a function's per-rank trace."""
+
+    kind: str  # barrier | collective | vote | wait | mutate | launch
+    name: str  # display, e.g. 'barrier' / 'os.replace()'
+    module: core.SourceModule
+    node: ast.AST
+    ranks: frozenset  # subset of ALL_RANKS that executes it
+    anchor: 'callgraph_lib.FuncInfo'  # function whose scan recorded it
+    direct: bool = True  # False when inlined from a callee summary
+
+
+@dataclasses.dataclass
+class ProtocolTable:
+    """One ``*_PROTOCOL`` literal parsed out of an analyzed module."""
+
+    module: core.SourceModule
+    name: str
+    node: ast.AST
+    table: dict
+
+
+@dataclasses.dataclass
+class PodAnalysis:
+    """Everything the pod rules consume, computed once per project."""
+
+    project: core.Project
+    graph: callgraph_lib.CallGraph
+    registry: dict[str, str]
+    findings: list[core.Finding]  # KFL301 / KFL302 / KFL303
+    mutations: list[OpEvent]  # every mutate event, rank-partial or not
+    tables: list[ProtocolTable]
+    table_problems: list[core.Finding]
+    reverse: dict[int, list[callgraph_lib.FuncInfo]]
+    _direct_ops_cache: dict[int, list[tuple[str, str]]] = (
+        dataclasses.field(default_factory=dict)
+    )
+    _reach_cache: dict[int, set[tuple[str, str]]] = (
+        dataclasses.field(default_factory=dict)
+    )
+    _summaries: dict[int, list[OpEvent]] = (
+        dataclasses.field(default_factory=dict)
+    )
+
+    # ---------------------------------------------------- reach / ordering
+
+    def direct_ops(self, info: callgraph_lib.FuncInfo) -> list[
+        tuple[str, str]
+    ]:
+        """(kind, name) of every registry op written directly in ``info``
+        — rank semantics ignored; presence is all reach queries need."""
+        cached = self._direct_ops_cache.get(id(info.node))
+        if cached is not None:
+            return cached
+        out: list[tuple[str, str]] = []
+        for node in core.walk_skipping_functions(info.node):
+            if isinstance(node, ast.Call):
+                name = core.call_name(node.func)
+            elif isinstance(node, ast.Attribute):
+                # a bare reference like passing
+                # ``pending.handle.wait_until_finished`` to a retry
+                # wrapper still takes the op in this context
+                name = node.attr
+            else:
+                continue
+            kind = self.registry.get(name or '')
+            if kind is not None:
+                out.append((kind, name))
+        self._direct_ops_cache[id(info.node)] = out
+        return out
+
+    def reach_ops(
+        self, info: callgraph_lib.FuncInfo
+    ) -> set[tuple[str, str]]:
+        """(kind, name) of every registry op in ``info``'s forward
+        transitive call closure (callees resolved conservatively)."""
+        cached = self._reach_cache.get(id(info.node))
+        if cached is not None:
+            return cached
+        ops: set[tuple[str, str]] = set()
+        seen: set[int] = set()
+        stack = [info]
+        while stack:
+            cur = stack.pop()
+            if id(cur.node) in seen:
+                continue
+            seen.add(id(cur.node))
+            ops.update(self.direct_ops(cur))
+            stack.extend(self.graph.edges_of(cur))
+        self._reach_cache[id(info.node)] = ops
+        return ops
+
+    def roots_of(
+        self, info: callgraph_lib.FuncInfo
+    ) -> list[callgraph_lib.FuncInfo]:
+        """Backward closure endpoints: functions reaching ``info`` that
+        have no analyzed callers themselves (protocol entry contexts).
+        A caller cycle with no external entry degrades to ``info``."""
+        seen = {id(info.node)}
+        stack = [info]
+        roots: list[callgraph_lib.FuncInfo] = []
+        while stack:
+            cur = stack.pop()
+            callers = [
+                c for c in self.reverse.get(id(cur.node), [])
+                if id(c.node) != id(cur.node)
+            ]
+            if not callers:
+                roots.append(cur)
+                continue
+            for c in callers:
+                if id(c.node) not in seen:
+                    seen.add(id(c.node))
+                    stack.append(c)
+        return roots or [info]
+
+    def context_ordered(self, info: callgraph_lib.FuncInfo) -> tuple[
+        bool, 'callgraph_lib.FuncInfo | None'
+    ]:
+        """(every root context reaches an ordering op, first bad root)."""
+        for root in self.roots_of(info):
+            kinds = {kind for kind, _ in self.reach_ops(root)}
+            if not (kinds & ORDERING_KINDS):
+                return False, root
+        return True, None
+
+
+# ------------------------------------------------------------ registry/tables
+
+
+def _module_literal_assigns(mod: core.SourceModule):
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+            isinstance(node.targets[0], ast.Name)
+        ):
+            yield node.targets[0].id, node
+
+
+def load_op_registry(project: core.Project) -> dict[str, str]:
+    ops = dict(DEFAULT_PROTOCOL_OPS)
+    for mod in project.modules:
+        for name, node in _module_literal_assigns(mod):
+            if name != 'PROTOCOL_OPS':
+                continue
+            try:
+                val = ast.literal_eval(node.value)
+            except ValueError:
+                continue
+            if isinstance(val, dict):
+                ops.update({str(k): str(v) for k, v in val.items()})
+    return ops
+
+
+def load_protocol_tables(
+    project: core.Project,
+) -> tuple[list[ProtocolTable], list[core.Finding]]:
+    """Every module-level ``*_PROTOCOL`` dict literal, plus findings for
+    the ones that are not pure literals (the tier cannot verify what it
+    cannot read without importing)."""
+    tables: list[ProtocolTable] = []
+    problems: list[core.Finding] = []
+    for mod in project.modules:
+        for name, node in _module_literal_assigns(mod):
+            if not name.endswith('_PROTOCOL') or name == 'PROTOCOL_OPS':
+                continue
+            try:
+                val = ast.literal_eval(node.value)
+            except ValueError:
+                problems.append(core.finding_at(
+                    mod, node, 'KFL305',
+                    f'{name} is not a pure literal: the pod tier parses '
+                    'protocol tables from the AST without importing the '
+                    'module, so computed tables cannot be model-checked',
+                ))
+                continue
+            if isinstance(val, dict):
+                tables.append(ProtocolTable(mod, name, node, val))
+    return tables, problems
+
+
+# --------------------------------------------------------- rank-test algebra
+
+
+def _is_rank_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and (
+        core.call_name(node.func) in _RANK_FUNCS
+    )
+
+
+def _contains_rank_taint(node: ast.AST, tainted: set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+        if _is_rank_call(sub):
+            return True
+    return False
+
+
+def _cmp(op: ast.cmpop, a: int, b: int) -> bool | None:
+    if isinstance(op, ast.Eq):
+        return a == b
+    if isinstance(op, ast.NotEq):
+        return a != b
+    if isinstance(op, ast.Lt):
+        return a < b
+    if isinstance(op, ast.LtE):
+        return a <= b
+    if isinstance(op, ast.Gt):
+        return a > b
+    if isinstance(op, ast.GtE):
+        return a >= b
+    return None
+
+
+def _rank_truth(node: ast.AST) -> dict[str, bool] | None:
+    """Per-virtual-rank truth of a rank test, or None when the test is
+    not a rank test — or not *constant* across the nonzero ranks the
+    ``'p'`` rank stands for (``process_index() == 1`` splits the
+    peers)."""
+    if _is_rank_call(node):
+        return {RANK0: False, RANKP: True}  # bare truthiness
+    if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+        return None
+    left, op, right = node.left, node.ops[0], node.comparators[0]
+    if _is_rank_call(left) and isinstance(right, ast.Constant):
+        const, flipped = right.value, False
+    elif _is_rank_call(right) and isinstance(left, ast.Constant):
+        const, flipped = left.value, True
+    else:
+        return None
+    if not isinstance(const, int) or isinstance(const, bool):
+        return None
+
+    def ev(rank_value: int) -> bool | None:
+        return (
+            _cmp(op, const, rank_value) if flipped
+            else _cmp(op, rank_value, const)
+        )
+
+    zero = ev(0)
+    peers = {ev(n) for n in (1, 2, 10 ** 6)}  # constant over all n >= 1?
+    if zero is None or len(peers) != 1 or None in peers:
+        return None
+    return {RANK0: zero, RANKP: peers.pop()}
+
+
+@dataclasses.dataclass(frozen=True)
+class TestInfo:
+    kind: str  # 'uniform' | 'rank' | 'opaque'
+    may_true: frozenset = ALL_RANKS  # ranks that can take the branch
+    may_false: frozenset = ALL_RANKS  # ranks that can skip it
+    exact: bool = False  # may_true/may_false partition ALL_RANKS
+
+
+_UNIFORM = TestInfo('uniform')
+_OPAQUE = TestInfo('opaque')
+
+
+def classify_test(node: ast.AST, tainted: set[str]) -> TestInfo:
+    truth = _rank_truth(node)
+    if truth is not None:
+        mt = frozenset(r for r in ALL_RANKS if truth[r])
+        return TestInfo('rank', mt, ALL_RANKS - mt, exact=True)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        inner = classify_test(node.operand, tainted)
+        return TestInfo(inner.kind, inner.may_false, inner.may_true,
+                        inner.exact)
+    if isinstance(node, ast.BoolOp):
+        infos = [classify_test(v, tainted) for v in node.values]
+        if isinstance(node.op, ast.And):
+            if any(i.kind == 'opaque' for i in infos):
+                return _OPAQUE
+            ranky = [i for i in infos if i.kind == 'rank']
+            if not ranky:
+                return _UNIFORM
+            mt = ALL_RANKS
+            for i in ranky:
+                mt &= i.may_true
+            if len(ranky) == len(infos) and all(i.exact for i in ranky):
+                return TestInfo('rank', mt, ALL_RANKS - mt, exact=True)
+            # an unknown uniform conjunct may be False for everyone:
+            # the rank bound caps who MAY enter, nobody must
+            return TestInfo('rank', mt, ALL_RANKS, exact=False)
+        if any(i.kind != 'uniform' for i in infos):
+            return _OPAQUE  # rank term under `or`: no useful bound
+        return _UNIFORM
+    if _contains_rank_taint(node, tainted):
+        return _OPAQUE
+    return _UNIFORM
+
+
+def _body_only_exits(body: list[ast.stmt]) -> bool:
+    return rules_spmd._body_only_exits(body)
+
+
+# ------------------------------------------------------------------- walker
+
+
+def _ranks_str(ranks: frozenset) -> str:
+    if ranks == ALL_RANKS:
+        return 'all ranks'
+    if ranks == frozenset((RANK0,)):
+        return 'rank 0 only'
+    if ranks == frozenset((RANKP,)):
+        return 'nonzero ranks only'
+    return 'no rank'
+
+
+class _Walker:
+    """Extracts one function's per-rank protocol trace; emits the
+    structural findings (KFL301/302/303) along the way."""
+
+    def __init__(
+        self,
+        analysis: PodAnalysis,
+        info: callgraph_lib.FuncInfo,
+        emit: bool = True,
+        visiting: frozenset = frozenset(),
+    ):
+        self.an = analysis
+        self.info = info
+        self.mod = info.module
+        self.emit = emit
+        self.visiting = visiting | {id(info.node)}
+        self.tainted: set[str] = set()
+        self.findings: list[core.Finding] = []
+        self.ops: list[OpEvent] = []  # direct protocol ops, flat
+        self.mutations: list[OpEvent] = []  # direct + inlined
+
+    def run(self) -> '_Walker':
+        node = self.info.node
+        if isinstance(node, ast.Lambda):
+            self._scan_expr(node.body, ALL_RANKS, ALL_RANKS)
+        else:
+            self._walk(node.body, ALL_RANKS)
+        return self
+
+    def _finding(self, node: ast.AST, code: str, message: str) -> None:
+        if self.emit:
+            self.findings.append(
+                core.finding_at(self.mod, node, code, message)
+            )
+
+    # ------------------------------------------------------------ statements
+
+    def _walk(
+        self, stmts: list[ast.stmt], active: frozenset
+    ) -> list[OpEvent]:
+        """Process a statement sequence under ``active`` ranks; returns
+        the direct protocol-op events in program order (for arm
+        comparison at rank forks)."""
+        entry = active
+        events: list[OpEvent] = []
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                events += self._scan_expr(stmt.test, active, entry)
+                ti = classify_test(stmt.test, self.tainted)
+                if ti.kind == 'uniform':
+                    events += self._walk(stmt.body, active)
+                    events += self._walk(stmt.orelse, active)
+                    continue
+                b_ranks = active & ti.may_true
+                e_ranks = active & ti.may_false
+                ev_b = self._walk(stmt.body, b_ranks)
+                ev_e = self._walk(stmt.orelse, e_ranks)
+                self._compare_arms(stmt, ev_b, ev_e, ti)
+                events += ev_b + ev_e
+                if ti.exact:
+                    if _body_only_exits(stmt.body):
+                        active = e_ranks
+                    elif stmt.orelse and _body_only_exits(stmt.orelse):
+                        active = b_ranks
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                events += self._scan_expr(stmt.iter, active, entry)
+                divergent_trip = _contains_rank_taint(
+                    stmt.iter, self.tainted
+                )
+                body_ev = self._walk(stmt.body, active)
+                body_ev += self._walk(stmt.orelse, active)
+                if divergent_trip:
+                    self._flag_blocking(
+                        body_ev,
+                        'inside a loop whose trip count is '
+                        'rank-dependent: ranks enter it a different '
+                        'number of times and the collective stops '
+                        'pairing up',
+                    )
+                events += body_ev
+            elif isinstance(stmt, ast.While):
+                events += self._scan_expr(stmt.test, active, entry)
+                ti = classify_test(stmt.test, self.tainted)
+                body_ev = self._walk(stmt.body, active)
+                body_ev += self._walk(stmt.orelse, active)
+                if ti.kind != 'uniform':
+                    self._flag_blocking(
+                        body_ev,
+                        'inside a while-loop with a rank-dependent '
+                        'condition: ranks iterate differently and the '
+                        'collective stops pairing up',
+                    )
+                events += body_ev
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    events += self._scan_expr(
+                        item.context_expr, active, entry
+                    )
+                events += self._walk(stmt.body, active)
+            elif isinstance(stmt, ast.Try) or (
+                hasattr(ast, 'TryStar') and isinstance(stmt, ast.TryStar)
+            ):
+                events += self._walk(stmt.body, active)
+                for handler in stmt.handlers:
+                    events += self._walk(handler.body, active)
+                events += self._walk(stmt.orelse, active)
+                events += self._walk(stmt.finalbody, active)
+            elif isinstance(
+                stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)
+            ):
+                value = stmt.value
+                if value is not None:
+                    events += self._scan_expr(value, active, entry)
+                    if _contains_rank_taint(value, self.tainted):
+                        targets = (
+                            stmt.targets if isinstance(stmt, ast.Assign)
+                            else [stmt.target]
+                        )
+                        for tgt in targets:
+                            for sub in ast.walk(tgt):
+                                if isinstance(sub, ast.Name):
+                                    self.tainted.add(sub.id)
+            elif isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)
+            ):
+                continue  # nested definitions are their own graph nodes
+            else:
+                events += self._scan_expr(stmt, active, entry)
+        return events
+
+    # ----------------------------------------------------------- expressions
+
+    def _scan_expr(
+        self, node: ast.AST, active: frozenset, entry: frozenset
+    ) -> list[OpEvent]:
+        """Collect protocol ops / mutations / launches from one
+        non-compound statement or expression."""
+        events: list[OpEvent] = []
+        for sub in [node, *core.walk_skipping_functions(node)]:
+            if not isinstance(sub, ast.Call):
+                if isinstance(sub, ast.Lambda):
+                    self._inline(
+                        self.an.graph._lambda_info(self.info, sub), active
+                    )
+                continue
+            name = core.call_name(sub.func)
+            kind = self.an.registry.get(name or '')
+            if kind is not None:
+                ev = OpEvent(kind, name, self.mod, sub, active, self.info)
+                events.append(ev)
+                self.ops.append(ev)
+                if kind in BLOCKING_KINDS and active < entry:
+                    self._finding(
+                        sub, 'KFL302',
+                        f'{name}() is reached by {_ranks_str(active)} '
+                        'after an early rank-guard return in '
+                        f'{self.info.qualname}: peers never enter the '
+                        'collective and the participating ranks '
+                        'deadlock',
+                    )
+            desc = rules_spmd.mutation_call_desc(sub)
+            if desc is not None:
+                self.mutations.append(OpEvent(
+                    'mutate', desc, self.mod, sub, active, self.info
+                ))
+                continue
+            callee = self.an.graph.resolve(self.info, sub.func)
+            if callee is not None:
+                if self.an.graph._is_entry(callee):
+                    self._launch(sub, callee, active)
+                else:
+                    self._inline(callee, active)
+            if core.call_name(sub.func) in (
+                callgraph_lib.HOST_CALLBACK_FUNCS
+            ):
+                continue
+            for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+                for hit in self.an.graph._arg_edges(self.info, arg):
+                    if not self.an.graph._is_entry(hit):
+                        self._inline(hit, active)
+        return events
+
+    def _launch(
+        self, call: ast.Call, callee, active: frozenset
+    ) -> None:
+        self.ops.append(OpEvent(
+            'launch', callee.display, self.mod, call, active, self.info
+        ))
+        if active < ALL_RANKS:
+            self._finding(
+                call, 'KFL303',
+                f'jitted program {callee.display} launched by '
+                f'{_ranks_str(active)} (rank-divergent branch in '
+                f'{self.info.qualname}): ranks compile and run '
+                'different programs, so any collective inside '
+                'deadlocks and compile caches diverge',
+            )
+            return
+        tainted_args = [
+            arg
+            for arg in list(call.args) + [kw.value for kw in call.keywords]
+            if _contains_rank_taint(arg, self.tainted)
+        ]
+        if tainted_args:
+            self._finding(
+                call, 'KFL303',
+                f'jitted program {callee.display} takes a '
+                'process_index()-derived operand: per-rank shapes or '
+                'values fork the compiled program (divergent '
+                'compile caches, mismatched collectives); gather the '
+                'rank-dependent part on the host first',
+            )
+
+    def _inline(self, callee, active: frozenset) -> None:
+        """Absorb a resolvable callee's mutation summary so a caller's
+        rank guard taints the callee's writes (the cross-function shape
+        KFL002 structurally cannot see)."""
+        if len(self.visiting) > MAX_INLINE_DEPTH or (
+            id(callee.node) in self.visiting
+        ):
+            return
+        summary = self.an._summaries.get(id(callee.node))
+        if summary is None:
+            sub = _Walker(
+                self.an, callee, emit=False, visiting=self.visiting
+            ).run()
+            summary = sub.mutations
+            self.an._summaries[id(callee.node)] = summary
+        for ev in summary:
+            ranks = ev.ranks & active
+            if ranks:
+                self.mutations.append(dataclasses.replace(
+                    ev, ranks=ranks, anchor=self.info, direct=False
+                ))
+
+    # ------------------------------------------------------------- rank forks
+
+    def _flag_blocking(self, events: list[OpEvent], why: str) -> None:
+        for ev in events:
+            if ev.kind in BLOCKING_KINDS:
+                self._finding(
+                    ev.node, 'KFL302',
+                    f'{ev.name}() in {self.info.qualname} {why}',
+                )
+
+    def _compare_arms(
+        self,
+        stmt: ast.If,
+        ev_b: list[OpEvent],
+        ev_e: list[OpEvent],
+        ti: TestInfo,
+    ) -> None:
+        blk_b = [e for e in ev_b if e.kind in BLOCKING_KINDS]
+        blk_e = [e for e in ev_e if e.kind in BLOCKING_KINDS]
+        if not blk_b and not blk_e:
+            return
+        if not ti.exact:
+            self._flag_blocking(
+                blk_b + blk_e,
+                'sits under a rank-divergent branch the analyzer '
+                'cannot prove uniform (a rank test mixed with '
+                'rank-opaque conditions): some ranks may never enter '
+                'the collective',
+            )
+            return
+        names_b = [e.name for e in blk_b]
+        names_e = [e.name for e in blk_e]
+        if names_b == names_e:
+            return  # both arms run the same collective sequence
+        if Counter(names_b) == Counter(names_e):
+            self._finding(
+                stmt, 'KFL301',
+                f'ranks taking the two arms of this rank branch in '
+                f'{self.info.qualname} reach the same collectives in '
+                f'different order ({" -> ".join(names_b)} vs '
+                f'{" -> ".join(names_e)}): the runtime pairs them '
+                'positionally, so mismatched collectives exchange '
+                'garbage or deadlock',
+            )
+            return
+        surplus_b = Counter(names_b) - Counter(names_e)
+        surplus_e = Counter(names_e) - Counter(names_b)
+        for events, surplus in ((blk_b, surplus_b), (blk_e, surplus_e)):
+            remaining = dict(surplus)
+            for ev in events:
+                if remaining.get(ev.name, 0) > 0:
+                    remaining[ev.name] -= 1
+                    self._finding(
+                        ev.node, 'KFL302',
+                        f'{ev.name}() is entered by '
+                        f'{_ranks_str(ev.ranks)} on one arm of a rank '
+                        f'branch in {self.info.qualname} with no '
+                        'matching call on the other arm: the ranks '
+                        'that skip it leave the participants blocked '
+                        'forever',
+                    )
+
+
+# ------------------------------------------------------------------ analysis
+
+_CACHE: 'weakref.WeakKeyDictionary[core.Project, PodAnalysis]' = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def analyze_project(project: core.Project) -> PodAnalysis:
+    """Build (and memoize per Project) the full pod analysis: rank-forked
+    traces, structural findings, mutation events, protocol tables."""
+    cached = _CACHE.get(project)
+    if cached is not None:
+        return cached
+    graph = callgraph_lib.CallGraph(project)
+    tables, table_problems = load_protocol_tables(project)
+    analysis = PodAnalysis(
+        project=project,
+        graph=graph,
+        registry=load_op_registry(project),
+        findings=[],
+        mutations=[],
+        tables=tables,
+        table_problems=table_problems,
+        reverse=graph.reverse_edges(),
+    )
+    seen: set[int] = set()
+    for info in graph.functions.values():
+        if id(info.node) in seen or isinstance(info.node, ast.Lambda):
+            continue
+        seen.add(id(info.node))
+        if graph._is_entry(info):
+            continue  # device programs are the IR tier's jurisdiction
+        walker = _Walker(analysis, info).run()
+        analysis.findings.extend(walker.findings)
+        analysis.mutations.extend(walker.mutations)
+    _CACHE[project] = analysis
+    return analysis
+
+
+def divergent_mutations(analysis: PodAnalysis) -> list[OpEvent]:
+    """Mutation events executed by a proper subset of the ranks,
+    deduplicated by source position (a mutation can surface both in its
+    own function's scan and inlined into a guarded caller)."""
+    out: list[OpEvent] = []
+    seen: set[tuple[str, int, int, str]] = set()
+    for ev in analysis.mutations:
+        if not ev.ranks or ev.ranks == ALL_RANKS:
+            continue
+        key = (
+            ev.module.relpath, ev.node.lineno, ev.node.col_offset,
+            ev.anchor.display,
+        )
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(ev)
+    return out
+
+
+def ordered_mutation_keys(project: core.Project) -> set[tuple[str, int]]:
+    """(relpath, lineno) of rank-divergent mutations whose every root
+    calling context reaches a protocol ordering op — the cross-function
+    happens-before proof that lets KFL002 drop findings its
+    same-function scan cannot clear (this is what retired the four
+    inline suppressions in checkpoint.py / resilience/manager.py)."""
+    analysis = analyze_project(project)
+    ordered: set[tuple[str, int]] = set()
+    unordered: set[tuple[str, int]] = set()
+    for ev in divergent_mutations(analysis):
+        key = (ev.module.relpath, ev.node.lineno)
+        ok, _ = analysis.context_ordered(ev.anchor)
+        if ok:
+            ordered.add(key)
+        else:
+            unordered.add(key)
+    # a mutation reached through BOTH an ordered and an unordered anchor
+    # is not proven: every context must be ordered
+    return ordered - unordered

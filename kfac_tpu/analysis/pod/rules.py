@@ -1,0 +1,189 @@
+"""Pod-tier rules KFL301–KFL305.
+
+Thin adapters from the shared :mod:`protocol` analysis (built once per
+Project, memoized) and the :mod:`interleave` model checker onto the
+``core.Rule`` registry. KFL301–303 are emitted during the rank-forking
+walk itself; this module routes them by code. KFL304 and KFL305 are
+computed here from the analysis' mutation events and protocol tables.
+"""
+
+from __future__ import annotations
+
+from kfac_tpu.analysis import core
+from kfac_tpu.analysis.pod import interleave, protocol
+
+
+def _structural(project: core.Project, code: str) -> list[core.Finding]:
+    analysis = protocol.analyze_project(project)
+    return [f for f in analysis.findings if f.code == code]
+
+
+def check_collective_order(project: core.Project) -> list[core.Finding]:
+    return _structural(project, 'KFL301')
+
+
+def check_conditional_collective(
+    project: core.Project,
+) -> list[core.Finding]:
+    return _structural(project, 'KFL302')
+
+
+def check_divergent_launch(project: core.Project) -> list[core.Finding]:
+    return _structural(project, 'KFL303')
+
+
+def check_write_race(project: core.Project) -> list[core.Finding]:
+    """KFL304: a rank-divergent filesystem mutation reachable from a
+    calling context that never takes a protocol ordering op."""
+    analysis = protocol.analyze_project(project)
+    findings: list[core.Finding] = []
+    seen: set[tuple[str, int]] = set()
+    for ev in protocol.divergent_mutations(analysis):
+        ok, bad_root = analysis.context_ordered(ev.anchor)
+        if ok:
+            continue
+        key = (ev.module.relpath, ev.node.lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        root = bad_root.display if bad_root is not None else '?'
+        findings.append(core.finding_at(
+            ev.module, ev.node, 'KFL304',
+            f'{ev.name} runs on {protocol._ranks_str(ev.ranks)} '
+            f'(via {ev.anchor.qualname}) but the calling context '
+            f'rooted at {root} reaches no barrier / collective / vote '
+            '/ wait_until_finished: peers can race past the mutation '
+            'and read half-written state',
+        ))
+    return findings
+
+
+def check_protocol_tables(project: core.Project) -> list[core.Finding]:
+    """KFL305: declared ``*_PROTOCOL`` tables must satisfy the protocol
+    invariants under bounded fault exploration, and the function each
+    table names must still reach ops of the kinds the table declares
+    (so deleting the real barrier rots the table check, not just the
+    prose)."""
+    analysis = protocol.analyze_project(project)
+    findings = list(analysis.table_problems)
+    for table in analysis.tables:
+        for problem in interleave.check_table(table.table):
+            findings.append(core.finding_at(
+                table.module, table.node, 'KFL305',
+                f'{table.name}: {problem}',
+            ))
+        findings.extend(_crosscheck(analysis, table))
+    return findings
+
+
+def _crosscheck(
+    analysis: protocol.PodAnalysis, table: protocol.ProtocolTable
+) -> list[core.Finding]:
+    tbl = table.table
+    fname = tbl.get('function')
+    if not isinstance(fname, str):
+        return []  # the structural check already flags the missing key
+    info = analysis.graph.functions.get((table.module.modname, fname))
+    if info is None:
+        return [core.finding_at(
+            table.module, table.node, 'KFL305',
+            f'{table.name} names function {fname!r}, which does not '
+            f'exist in {table.module.relpath}: the table describes '
+            'code that is gone',
+        )]
+    reach = analysis.reach_ops(info)
+    reach_kinds = {kind for kind, _ in reach}
+    reach_names = {name for _, name in reach}
+    findings: list[core.Finding] = []
+    if tbl.get('machine') == 'sequence':
+        for step in tbl.get('steps', ()):
+            if not isinstance(step, dict):
+                continue
+            kind = step.get('kind')
+            if kind in protocol.ORDERING_KINDS and (
+                kind not in reach_kinds
+            ):
+                findings.append(core.finding_at(
+                    table.module, table.node, 'KFL305',
+                    f'{table.name} declares a {kind} step '
+                    f'{step.get("op")!r} but {fname} no longer reaches '
+                    f'any {kind}-kind protocol op: the code drifted '
+                    'from its protocol table',
+                ))
+    else:
+        vote_op = tbl.get('vote_op')
+        if isinstance(vote_op, str) and vote_op not in reach_names:
+            findings.append(core.finding_at(
+                table.module, table.node, 'KFL305',
+                f'{table.name} declares vote_op {vote_op!r} but '
+                f'{fname} no longer reaches it: commits are no longer '
+                'gated on a fleet-wide vote',
+            ))
+    return findings
+
+
+core.register(core.Rule(
+    code='KFL301',
+    name='collective-order-divergence',
+    what='arms of a rank-divergent branch that reach the same '
+         'collectives in different order',
+    why='collectives pair positionally across ranks — reordered arms '
+        'exchange garbage between mismatched calls or deadlock, and '
+        'nothing crashes at the divergence point',
+    check=check_collective_order,
+    kind='pod',
+))
+
+core.register(core.Rule(
+    code='KFL302',
+    name='conditional-collective',
+    what='a barrier / collective / vote reachable by only a subset of '
+         'the virtual ranks (one-armed rank branches, post-rank-return '
+         'code, rank-dependent loop trip counts)',
+    why='a collective only some ranks enter blocks the participants '
+        'forever: the classic SPMD deadlock, invisible to per-rank '
+        'analysis and to single-host tests',
+    check=check_conditional_collective,
+    kind='pod',
+))
+
+core.register(core.Rule(
+    code='KFL303',
+    name='rank-divergent-launch',
+    what='jitted entry points launched under a rank-divergent branch '
+         'or fed process_index()-derived operands',
+    why='ranks then compile and execute different programs: compile '
+        'caches diverge and any collective inside the program pairs '
+        'with nothing on the missing ranks',
+    check=check_divergent_launch,
+    kind='pod',
+))
+
+core.register(core.Rule(
+    code='KFL304',
+    name='cross-rank-write-race',
+    what='rank-divergent filesystem mutations whose calling contexts '
+         'reach no protocol ordering op (happens-before graph over the '
+         'callgraph, lambdas and retry wrappers included)',
+    why='the cross-function upgrade of KFL002: a barrier in the caller '
+        'orders a mutation in the callee and vice versa — this rule '
+        'proves it, which is what retired the four inline KFL002 '
+        'suppressions',
+    check=check_write_race,
+    kind='pod',
+))
+
+core.register(core.Rule(
+    code='KFL305',
+    name='protocol-invariant',
+    what='declared *_PROTOCOL tables: single-writer LATEST, '
+         'barrier-ordered clears, commit-after-wait under every crash '
+         'prefix, vote totality, abort purity, one commit per '
+         'boundary — plus drift between table and code',
+    why='the resilience fault injectors probe exactly these '
+        'invariants at runtime; the model check fails the lint the '
+        'moment the declared protocol stops satisfying them, before a '
+        'pod ever runs',
+    check=check_protocol_tables,
+    kind='pod',
+))

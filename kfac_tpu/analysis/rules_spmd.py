@@ -56,36 +56,44 @@ def _body_only_exits(body: list[ast.stmt]) -> bool:
     )
 
 
+def mutation_call_desc(node: ast.Call) -> str | None:
+    """Description of ``node`` if it mutates the filesystem (the KFL002
+    grammar: ``os.*``/``shutil.*`` mutators and ``open`` in a writing
+    mode), else None. Shared with the pod tier so both judge the same
+    mutation vocabulary."""
+    func = node.func
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        base, attr = func.value.id, func.attr
+        if attr in _MUTATING_ATTRS.get(base, frozenset()):
+            return f'{base}.{attr}()'
+        return None
+    if isinstance(func, ast.Name) and func.id == 'open':
+        for i, arg in enumerate(node.args):
+            if i == 1 and isinstance(arg, ast.Constant) and (
+                isinstance(arg.value, str)
+                and any(c in arg.value for c in 'wax+')
+            ):
+                return "open(..., 'w')"
+        for kw in node.keywords:
+            if kw.arg == 'mode' and isinstance(
+                kw.value, ast.Constant
+            ) and isinstance(kw.value.value, str) and any(
+                c in kw.value.value for c in 'wax+'
+            ):
+                return "open(..., 'w')"
+    return None
+
+
 def _mutation_calls(stmts: list[ast.stmt]) -> list[tuple[ast.Call, str]]:
     """(call node, description) for every file mutation in ``stmts``,
     including inside nested control flow but not nested functions."""
     out: list[tuple[ast.Call, str]] = []
     for stmt in stmts:
         for node in [stmt, *core.walk_skipping_functions(stmt)]:
-            if not isinstance(node, ast.Call):
-                continue
-            func = node.func
-            if isinstance(func, ast.Attribute) and isinstance(
-                func.value, ast.Name
-            ):
-                base, attr = func.value.id, func.attr
-                if attr in _MUTATING_ATTRS.get(base, frozenset()):
-                    out.append((node, f'{base}.{attr}()'))
-                    continue
-            if isinstance(func, ast.Name) and func.id == 'open':
-                for i, arg in enumerate(node.args):
-                    if i == 1 and isinstance(arg, ast.Constant) and (
-                        isinstance(arg.value, str)
-                        and any(c in arg.value for c in 'wax+')
-                    ):
-                        out.append((node, "open(..., 'w')"))
-                for kw in node.keywords:
-                    if kw.arg == 'mode' and isinstance(
-                        kw.value, ast.Constant
-                    ) and isinstance(kw.value.value, str) and any(
-                        c in kw.value.value for c in 'wax+'
-                    ):
-                        out.append((node, "open(..., 'w')"))
+            if isinstance(node, ast.Call):
+                desc = mutation_call_desc(node)
+                if desc is not None:
+                    out.append((node, desc))
     return out
 
 
@@ -96,6 +104,18 @@ def _has_ordering_edge(fn: ast.AST) -> bool:
         ):
             return True
     return False
+
+
+def _pod_ordered_keys(project: core.Project) -> set[tuple[str, int]]:
+    """(relpath, lineno) of mutations the pod tier proved ordered
+    cross-function. The lazy import breaks the cycle (pod builds on this
+    module's mutation grammar); on any pod failure KFL002 falls back to
+    its old, stricter same-function judgement."""
+    try:
+        from kfac_tpu.analysis.pod import protocol as pod_protocol
+        return pod_protocol.ordered_mutation_keys(project)
+    except Exception:
+        return set()
 
 
 def check_rank_divergent_io(project: core.Project) -> list[core.Finding]:
@@ -113,8 +133,16 @@ def check_rank_divergent_io(project: core.Project) -> list[core.Finding]:
     ``sync_global_devices`` / ``assert_same_step`` edge, which is what
     orders the mutation against the peers. Without one, a peer can race
     past the write (the PR-4 emergency-checkpoint rotation bug).
+
+    Mutations the same-function scan cannot clear get one more chance:
+    the pod tier's happens-before proof (KFL304 machinery) clears a
+    mutation when every root calling context reaches an ordering op.
+    That cross-function power is what retired the four inline
+    suppressions this rule used to need in ``checkpoint.py`` and
+    ``resilience/manager.py``.
     """
     findings: list[core.Finding] = []
+    ordered_keys: set[tuple[str, int]] | None = None
     for mod in project.modules:
         for fn in ast.walk(mod.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -139,6 +167,10 @@ def check_rank_divergent_io(project: core.Project) -> list[core.Finding]:
                 if id(call) in seen:
                     continue
                 seen.add(id(call))
+                if ordered_keys is None:
+                    ordered_keys = _pod_ordered_keys(project)
+                if (mod.relpath, call.lineno) in ordered_keys:
+                    continue
                 findings.append(core.finding_at(
                     mod, call, 'KFL002',
                     f'{desc} under a process_index() guard in {fn.name} '

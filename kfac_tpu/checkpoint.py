@@ -303,7 +303,6 @@ def save(
     if jax.process_index() == 0 and mpath0 is not None and (
         os.path.exists(mpath0)
     ):
-        # kfaclint: disable=KFL002 (sidecar has a single writer — rank 0; peers never read it until restore, which the caller orders)
         os.remove(mpath0)
 
     def _finalize_manifest() -> None:
@@ -320,7 +319,6 @@ def save(
                     stacklevel=3,
                 )
             else:
-                # kfaclint: disable=KFL002 (runs strictly after wait_until_finished; single writer, no cross-rank reader mid-save)
                 with open(mpath, 'w') as f:
                     json.dump(layout_manifest(engine), f, indent=1)
 

@@ -21,6 +21,33 @@ from jax.sharding import Mesh
 from kfac_tpu import assignment as assignment_lib
 from kfac_tpu.parallel import mesh as mesh_lib
 
+#: The cross-host protocol op registry. Every host-side operation that
+#: participates in cross-rank coordination is declared here, by function
+#: name, with its protocol kind:
+#:
+#: - ``barrier``    — blocks until every process arrives (name-checked).
+#: - ``collective`` — fixed-shape all-gather; every process must call it
+#:   at the same point in its call sequence.
+#: - ``vote``       — a collective whose result gates a pod-wide
+#:   decision (commit/abort semantics).
+#: - ``wait``       — host-local durability edge (async-save completion);
+#:   orders a subsequent single-writer mutation after the written bytes.
+#:
+#: The kfaclint pod tier (``kfac_tpu/analysis/pod/``) reads this table
+#: *from the AST* (it never imports this module) and uses it to extract
+#: per-rank protocol traces, so adding a coordination primitive here is
+#: what makes KFL301–KFL305 aware of it. Keep the dict a pure literal.
+PROTOCOL_OPS = {
+    'barrier': 'barrier',
+    'sync_global_devices': 'barrier',
+    'allgather_scalars': 'collective',
+    'process_allgather': 'collective',
+    'agree_emergency': 'collective',
+    'assert_same_step': 'collective',
+    'agree_decision': 'vote',
+    'wait_until_finished': 'wait',
+}
+
 
 def initialize(
     coordinator_address: str | None = None,
@@ -57,6 +84,11 @@ def initialize(
             return
         # in a detected multi-host environment, failures are real and
         # must surface
+    if (jax.config.jax_platforms or '').startswith('cpu'):
+        # the default XLA CPU client rejects multiprocess computations;
+        # the gloo transport (what the multi-process CPU tests rendezvous
+        # over) must be selected before the backend is created
+        jax.config.update('jax_cpu_collectives_implementation', 'gloo')
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

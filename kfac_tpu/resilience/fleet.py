@@ -74,6 +74,40 @@ SEARCH_OVERRIDE_KEYS = (
 #: finds the layout the job was actually running
 PLAN_FILENAME = 'PLAN.json'
 
+#: Introspectable migration state machine for the kfaclint pod tier
+#: (KFL305). The pod rules parse this literal from the AST (never
+#: importing this module) and model-check it under the fault alphabet
+#: (crash at any state, vote outcome): every state reachable, both vote
+#: outcomes handled wherever one is, controller state mutated ONLY on a
+#: ``vote-commit`` transition, and abort transitions mutating nothing —
+#: the mutate-nothing-until-verified contract of
+#: :meth:`FleetController._maybe_migrate` as a checkable artifact. The
+#: declared ``vote_op`` is additionally cross-checked against the ops
+#: reachable from ``_maybe_migrate``, so dropping the real
+#: ``agree_decision`` call breaks the lint even with the table intact.
+#: Keep it a pure literal.
+MIGRATION_PROTOCOL = {
+    'machine': 'state',
+    'name': 'fleet-migration',
+    'function': 'FleetController._maybe_migrate',
+    'vote_op': 'agree_decision',
+    'states': ('idle', 'armed', 'boundary', 'committed', 'aborted'),
+    'initial': 'idle',
+    'transitions': (
+        {'from': 'idle', 'event': 'drift', 'to': 'armed', 'mutates': ()},
+        {'from': 'armed', 'event': 'checkpoint-boundary', 'to': 'boundary',
+         'mutates': ()},
+        {'from': 'boundary', 'event': 'vote-commit', 'to': 'committed',
+         'mutates': ('plan', 'engine', 'train_state')},
+        {'from': 'boundary', 'event': 'vote-abort', 'to': 'aborted',
+         'mutates': ()},
+        {'from': 'committed', 'event': 'cooldown', 'to': 'idle',
+         'mutates': ()},
+        {'from': 'aborted', 'event': 'cooldown', 'to': 'idle',
+         'mutates': ()},
+    ),
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class FleetConfig:

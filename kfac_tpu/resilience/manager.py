@@ -47,6 +47,34 @@ _CKPT_NAME = 'ckpt'
 #: 0 = no request, 1 = save-and-continue, 2 = save-and-exit
 _CODE_NONE, _CODE_CONTINUE, _CODE_EXIT = 0, 1, 2
 
+#: Introspectable save-protocol table for the kfaclint pod tier
+#: (KFL305). The pod rules parse this literal straight from the AST
+#: (never importing this module), model-check it under the fault
+#: alphabet (crash after any step, signal re-entry), and cross-check
+#: every ``barrier``/``wait`` step against the protocol ops actually
+#: reachable from :meth:`CheckpointManager.save` — so the table cannot
+#: rot away from the code, and deleting the real barrier breaks the
+#: lint even with the table intact. Step order is the LOGICAL commit
+#: order; the async path defers wait+commit to the next
+#: ``on_step``/``finalize`` but never reorders them. Keep it a pure
+#: literal.
+SAVE_PROTOCOL = {
+    'machine': 'sequence',
+    'name': 'checkpoint-save',
+    'function': 'CheckpointManager.save',
+    'steps': (
+        {'op': 'flush_pending', 'rank': 'all', 'kind': 'host'},
+        {'op': 'clear_stale_dir', 'rank': 0, 'kind': 'mutate',
+         'effect': 'mutate_dir'},
+        {'op': 'barrier', 'rank': 'all', 'kind': 'barrier'},
+        {'op': 'write_checkpoint', 'rank': 'all', 'kind': 'mutate',
+         'effect': 'write_step_dir'},
+        {'op': 'wait_until_finished', 'rank': 'all', 'kind': 'wait'},
+        {'op': 'commit_latest', 'rank': 0, 'kind': 'mutate',
+         'effect': 'point_latest'},
+    ),
+}
+
 
 class Preempted(RuntimeError):
     """Raised by :meth:`CheckpointManager.on_step` after a successful
@@ -239,12 +267,10 @@ class CheckpointManager:
             return
         latest = self._latest_path()
         tmp = f'{latest}.tmp.{os.getpid()}'
-        # kfaclint: disable=KFL002 (LATEST is written by rank 0 strictly after wait_until_finished; peers only read it at restore entry)
         with open(tmp, 'w') as f:
             f.write(os.path.basename(self.step_dir(step)) + '\n')
             f.flush()
             os.fsync(f.fileno())
-        # kfaclint: disable=KFL002 (atomic pointer flip, same single-writer argument as the tmp write above)
         os.replace(tmp, latest)
         self._prune(protect=step)
 

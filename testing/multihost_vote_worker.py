@@ -1,0 +1,92 @@
+"""Worker process for the 8-process multihost protocol smoke.
+
+Launched by tests/parallel/test_multihost.py with the same
+KFAC_TPU_COORDINATOR / KFAC_TPU_NUM_PROCESSES / KFAC_TPU_PROCESS_ID
+rendezvous surface as multihost_worker.py, but with ONE virtual device
+per process and no model step — the point is the coordination protocol
+itself at a pod-ish process count, cheap enough for eight workers on a
+single core:
+
+- ``agree_decision``: a unanimous round (all True) and a dissent round
+  (one rank votes False) must resolve identically everywhere;
+- ``agree_emergency``: one rank reports a signal code and one rank a
+  skewed step — every rank must receive the pod-wide (max code,
+  max step);
+- ``assert_same_step``: passes on agreement, and the divergent case
+  must raise on every rank (the gather is symmetric, so the negative
+  path is SPMD-safe to exercise);
+- ``barrier`` brackets the rounds;
+- ``hybrid_kaisa_mesh(0.5)`` over the 8x1 world must build the (4, 2)
+  host-major grid with whole-host gradient-worker columns.
+
+Prints one JSON line with every agreed value for the test to compare
+across ranks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
+
+from kfac_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize()
+
+
+def main() -> None:
+    expected = int(os.environ['KFAC_TPU_NUM_PROCESSES'])
+    assert jax.process_count() == expected, jax.process_count()
+    pidx = multihost.process_index()
+
+    multihost.barrier('vote-smoke-start')
+
+    vote_unanimous = multihost.agree_decision(True)
+    vote_dissent = multihost.agree_decision(pidx != 3)
+
+    # rank 2 saw an exit-semantics signal; rank 5 is one step ahead
+    # (shared-filesystem skew) — everyone must converge on (2, 18)
+    code = 2 if pidx == 2 else 0
+    step = 18 if pidx == 5 else 17
+    agreed_code, agreed_step = multihost.agree_emergency(code, step)
+
+    multihost.assert_same_step(agreed_step, 'vote smoke')
+    try:
+        multihost.assert_same_step(1000 + pidx, 'divergence probe')
+        skew_raises = False
+    except RuntimeError:
+        skew_raises = True
+
+    mesh = multihost.hybrid_kaisa_mesh(0.5)
+    col0_hosts = sorted(
+        d.process_index for d in mesh.devices[:, 0].ravel()
+    )
+
+    multihost.barrier('vote-smoke-end')
+    print(
+        json.dumps(
+            {
+                'process': pidx,
+                'n_processes': multihost.process_count(),
+                'vote_unanimous': vote_unanimous,
+                'vote_dissent': vote_dissent,
+                'agreed_code': agreed_code,
+                'agreed_step': agreed_step,
+                'skew_raises': skew_raises,
+                'mesh_shape': list(mesh.devices.shape),
+                'mesh_axes': list(mesh.axis_names),
+                'col0_hosts': col0_hosts,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == '__main__':
+    main()

@@ -9,9 +9,18 @@ exits 0 leaving a durable rotation a SECOND invocation resumes from
 (``Trainer.restore_latest``) with step/loss continuity.
 
 Usage: python resilience_worker.py <ckpt_dir> <max_steps> <save_interval>
-[<per_step_sleep_s>]. Emits one JSON line per event (start / step /
-preempted / done) on stdout; the parent reads the stream to time its
-signal and to assert continuity.
+[<per_step_sleep_s>] [<skew>]. Emits one JSON line per event (start /
+step / preempted / done) on stdout; the parent reads the stream to time
+its signal and to assert continuity.
+
+A nonzero ``skew`` simulates a pod peer running that many steps ahead:
+``multihost.process_count`` is shimmed to 2 and ``agree_emergency`` to
+return ``step + skew``, so the manager must take the multi-host
+coordination path and save the emergency checkpoint under the
+POD-AGREED step, not this host's local one — the PR-4 review-fix
+behavior (skewed hosts land in one rotation entry). The shim stays
+above jax: ``barrier`` / ``assert_same_step`` gate on the real
+``jax.process_count()`` and remain no-ops, so no rendezvous is needed.
 """
 
 from __future__ import annotations
@@ -44,6 +53,18 @@ def main() -> None:
     max_steps = int(sys.argv[2])
     save_interval = int(sys.argv[3])
     step_sleep = float(sys.argv[4]) if len(sys.argv) > 4 else 0.0
+    skew = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+
+    agree_calls: dict[str, int] = {}
+    if skew:
+        from kfac_tpu.parallel import multihost
+
+        def skewed_agree(code: int, step: int) -> tuple[int, int]:
+            agree_calls['local'] = step
+            return code, step + skew
+
+        multihost.process_count = lambda: 2
+        multihost.agree_emergency = skewed_agree
 
     m = models.TinyModel()
     x, y = models.regression_data(jax.random.PRNGKey(1))
@@ -93,6 +114,8 @@ def main() -> None:
             saved_step=exc.step,
             path=exc.path,
             latest=manager.latest_step(),
+            local_step=agree_calls.get('local'),
+            rotation=manager.rotation_steps(),
         )
         sys.exit(0)
 

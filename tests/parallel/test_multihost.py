@@ -22,6 +22,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 WORKER = os.path.join(REPO, 'testing', 'multihost_worker.py')
+VOTE_WORKER = os.path.join(REPO, 'testing', 'multihost_vote_worker.py')
 
 
 def _free_port() -> int:
@@ -30,7 +31,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_workers(n: int, port: int):
+def _launch_workers(
+    n: int, port: int, worker: str = WORKER, devices_per_proc: int = 2
+):
     procs = []
     for pid in range(n):
         env = dict(os.environ)
@@ -42,7 +45,8 @@ def _launch_workers(n: int, port: int):
             if 'xla_force_host_platform_device_count' not in f
         )
         env['XLA_FLAGS'] = (
-            flags + ' --xla_force_host_platform_device_count=2'
+            flags
+            + f' --xla_force_host_platform_device_count={devices_per_proc}'
         ).strip()
         env['KFAC_TPU_COORDINATOR'] = f'127.0.0.1:{port}'
         env['KFAC_TPU_NUM_PROCESSES'] = str(n)
@@ -55,7 +59,7 @@ def _launch_workers(n: int, port: int):
         )
         procs.append(
             subprocess.Popen(
-                [sys.executable, WORKER],
+                [sys.executable, worker],
                 env=env,
                 cwd=REPO,
                 stdout=subprocess.PIPE,
@@ -66,27 +70,14 @@ def _launch_workers(n: int, port: int):
     return procs
 
 
-@pytest.mark.slow
-@pytest.mark.parametrize('n_procs', [2, 4])
-def test_multi_process_step_matches_single_process(n_procs):
-    """{2, 4} OS processes x 2 virtual devices each rendezvous through
-    jax.distributed.initialize and run a real DistributedKFAC step over a
-    hybrid mesh; replicated outputs agree across processes and match the
-    same step computed in one process. The 4-process case exercises a
-    4-host x 2-device hybrid grid (the DCN-topology shape multihost.
-    hybrid_kaisa_mesh exists for) rather than the minimal pair."""
-    if len(jax.devices()) < 2 * n_procs:
-        pytest.skip(
-            f'single-process reference needs {2 * n_procs} virtual '
-            f'devices (XLA_FLAGS overrides the conftest default)'
-        )
-    port = _free_port()
-    procs = _launch_workers(n_procs, port)
+def _collect_results(procs, timeout: int = 600):
+    """JSON result line per worker; kills the pod on any failure so a
+    blocked rendezvous never orphans workers on this single core."""
     results = []
     try:
         for p in procs:
             try:
-                out, err = p.communicate(timeout=600)
+                out, err = p.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
                 # collect every worker's stderr tail — a hang with no
                 # diagnostics is undebuggable (the finally kills them)
@@ -114,6 +105,26 @@ def test_multi_process_step_matches_single_process(n_procs):
             if q.poll() is None:
                 q.kill()
                 q.wait()
+    return results
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('n_procs', [2, 4])
+def test_multi_process_step_matches_single_process(n_procs):
+    """{2, 4} OS processes x 2 virtual devices each rendezvous through
+    jax.distributed.initialize and run a real DistributedKFAC step over a
+    hybrid mesh; replicated outputs agree across processes and match the
+    same step computed in one process. The 4-process case exercises a
+    4-host x 2-device hybrid grid (the DCN-topology shape multihost.
+    hybrid_kaisa_mesh exists for) rather than the minimal pair."""
+    if len(jax.devices()) < 2 * n_procs:
+        pytest.skip(
+            f'single-process reference needs {2 * n_procs} virtual '
+            f'devices (XLA_FLAGS overrides the conftest default)'
+        )
+    port = _free_port()
+    procs = _launch_workers(n_procs, port)
+    results = _collect_results(procs)
 
     # every process saw the full world and agrees bit-for-bit on the
     # replicated outputs
@@ -167,6 +178,39 @@ def test_multi_process_step_matches_single_process(n_procs):
     )
     np.testing.assert_allclose(results[0]['loss'], float(loss), rtol=1e-5)
     np.testing.assert_allclose(results[0]['checksum'], checksum, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_eight_process_protocol_smoke():
+    """8 OS processes x 1 virtual device rendezvous and drive the raw
+    coordination protocol — no model step, just the ops the pod
+    analyzer verifies statically: unanimous and dissenting
+    ``agree_decision`` rounds, ``agree_emergency`` (max code, max step)
+    convergence under a one-rank signal plus a one-rank step skew,
+    ``assert_same_step`` on both the agreeing and the diverging path,
+    barriers, and the (4, 2) host-major ``hybrid_kaisa_mesh`` grid over
+    a world wider than any single host."""
+    n_procs = 8
+    port = _free_port()
+    procs = _launch_workers(
+        n_procs, port, worker=VOTE_WORKER, devices_per_proc=1
+    )
+    results = _collect_results(procs)
+
+    assert sorted(r['process'] for r in results) == list(range(n_procs))
+    for r in results:
+        assert r['n_processes'] == n_procs
+        assert r['vote_unanimous'] is True
+        # rank 3's veto must reach every rank (unanimous min-reduction)
+        assert r['vote_dissent'] is False
+        # rank 2's signal code and rank 5's skewed step, pod-wide
+        assert (r['agreed_code'], r['agreed_step']) == (2, 18)
+        assert r['skew_raises'] is True
+        # 8 devices at grad_worker_fraction 0.5 -> (gw=4, col=2),
+        # host-major: the first column is whole hosts 0..3
+        assert r['mesh_shape'] == [4, 2]
+        assert r['mesh_axes'] == ['kfac_gw', 'kfac_col']
+        assert r['col0_hosts'] == [0, 1, 2, 3]
 
 
 @pytest.mark.slow

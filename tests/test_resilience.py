@@ -709,6 +709,73 @@ def test_subprocess_sigterm_leaves_resumable_checkpoint(tmp_path):
     assert done['latest'] > saved
 
 
+@pytest.mark.slow
+def test_subprocess_sigterm_agreed_step_single_rotation_entry(tmp_path):
+    """Real preemption under simulated pod skew: the worker shims
+    ``agree_emergency`` so a peer is 3 steps ahead at coordination time.
+    The emergency save must land under the POD-AGREED step — one
+    rotation entry, pointed at by LATEST — never this host's local step
+    (the PR-4 review fix: per-host saves at divergent steps tore the
+    rotation). The saved state itself still carries the local counter,
+    so a resume restarts from the local step inside the agreed entry."""
+    skew = 3
+    ckpt_dir = str(tmp_path / 'rot')
+    env = dict(os.environ)
+    env['PALLAS_AXON_POOL_IPS'] = ''  # never touch the TPU tunnel
+    env['JAX_PLATFORMS'] = 'cpu'
+    env.pop('XLA_FLAGS', None)  # single-device worker: fastest compile
+    env.setdefault(
+        'JAX_COMPILATION_CACHE_DIR', os.path.join(REPO, '.jax_cache')
+    )
+    err_path = tmp_path / 'worker.err'
+    with open(err_path, 'w') as errf:
+        proc = subprocess.Popen(
+            [
+                sys.executable, WORKER, ckpt_dir, '1000', '2', '0.1',
+                str(skew),
+            ],
+            stdout=subprocess.PIPE, stderr=errf, text=True, env=env,
+            cwd=REPO,
+        )
+        events = []
+        try:
+            for line in proc.stdout:
+                events.extend(_read_events(line))
+                if events and events[-1].get('event') == 'step' and (
+                    events[-1]['step'] >= 3
+                ):
+                    proc.send_signal(signal_mod.SIGTERM)
+                    break
+            out, _ = proc.communicate(timeout=300)
+        finally:
+            proc.kill()
+    events.extend(_read_events(out))
+    assert proc.returncode == 0, err_path.read_text()[-4000:]
+    pre = [e for e in events if e.get('event') == 'preempted']
+    assert pre, events
+    local = pre[0]['local_step']
+    saved = pre[0]['saved_step']
+    assert local is not None and local >= 3
+    # the agreed (skewed-peer) step names the checkpoint, not the local
+    assert saved == local + skew
+    assert pre[0]['latest'] == saved
+    # exactly one rotation entry for the agreed step, on disk and in the
+    # worker's own view of the rotation
+    assert pre[0]['rotation'].count(saved) == 1
+    assert os.path.isdir(os.path.join(ckpt_dir, f'step_{saved:08d}'))
+
+    # the agreed entry is restorable; the state inside carries the local
+    # counter (the peer was ahead, this host's weights are at `local`)
+    resume = subprocess.run(
+        [sys.executable, WORKER, ckpt_dir, str(local + 1), '2'],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300,
+    )
+    assert resume.returncode == 0, resume.stderr[-4000:]
+    ev2 = _read_events(resume.stdout)
+    start = next(e for e in ev2 if e['event'] == 'start')
+    assert start['resumed_step'] == local
+
+
 # ---------------------------------------------------------------- docs lint
 
 

@@ -6,13 +6,18 @@ I/O, ephemeral-pytree drift, recompile hazards, callback discipline)
 over ``kfac_tpu/``; with ``--ir`` the jaxpr-level IR rules
 (KFL201–KFL205: dtype drift, collective axes, sharding contracts,
 step-path callbacks, cost-model parity — these trace the real engines,
-so they want the 8-device CPU env the Makefile sets); and with ``--all``
+so they want the 8-device CPU env the Makefile sets); with ``--pod``
+the cross-rank SPMD protocol rules (KFL301–KFL305: collective order
+divergence, conditional collectives, rank-divergent launches, the
+cross-function write-race happens-before check, protocol-table model
+checking — stdlib-only, like the AST tier); and with ``--all``
 everything, including the docs-vs-code drift rules (KFL100–KFL105) that
 the four ``tools/lint_*.py`` wrappers delegate to. See docs/ANALYSIS.md
 for the rule table and suppression syntax.
 
     JAX_PLATFORMS=cpu python tools/kfaclint.py --all        # CI entry
     python tools/kfaclint.py --ir --smoke                   # fast IR tier
+    python tools/kfaclint.py --pod                          # pod tier
     python tools/kfaclint.py --rules KFL002 kfac_tpu/checkpoint.py
     python tools/kfaclint.py --baseline-remap old.py:new.py --all
     python tools/kfaclint.py --list-rules
@@ -154,6 +159,147 @@ def launch(cb, x):
     return io_callback(cb, None, x, ordered=False)
 ''',
     ),
+    'KFL301': (
+        # TP: arms of a rank branch reorder the same collectives
+        '''
+from kfac_tpu.parallel import multihost
+
+def sync(x):
+    if multihost.process_index() == 0:
+        multihost.barrier('a')
+        vals = multihost.allgather_scalars(x)
+    else:
+        vals = multihost.allgather_scalars(x)
+        multihost.barrier('a')
+    return vals
+''',
+        '''
+from kfac_tpu.parallel import multihost
+
+def sync(x):
+    if multihost.process_index() == 0:
+        prepare(x)
+    multihost.barrier('a')
+    return multihost.allgather_scalars(x)
+''',
+    ),
+    'KFL302': (
+        # TP: only rank 0 enters the unanimous vote — peers never arrive
+        '''
+from kfac_tpu.parallel import multihost
+
+def migrate(ok):
+    if multihost.process_index() == 0:
+        ok = multihost.agree_decision(ok)
+    return ok
+''',
+        '''
+from kfac_tpu.parallel import multihost
+
+def migrate(ok):
+    return multihost.agree_decision(ok)
+''',
+    ),
+    'KFL303': (
+        # TP: process_index()-derived operand feeds a jitted entry
+        '''
+import jax
+
+@jax.jit
+def step(x):
+    return x * 2
+
+def drive(x):
+    pidx = jax.process_index()
+    return step(x[: pidx + 1])
+''',
+        '''
+import jax
+
+@jax.jit
+def step(x):
+    return x * 2
+
+def drive(x):
+    return step(x)
+''',
+    ),
+    'KFL304': (
+        # TP: the manager-save shape with its barrier doctored out —
+        # the rank-0 rmtree hides inside a retry lambda, and no calling
+        # context reaches an ordering op
+        '''
+import os
+import shutil
+from kfac_tpu.parallel import multihost
+
+def _with_retries(what, fn):
+    return fn()
+
+def save(state, sdir):
+    if multihost.process_index() == 0 and os.path.exists(sdir):
+        _with_retries('clearing stale dir', lambda: shutil.rmtree(sdir))
+    write(state, sdir)
+''',
+        '''
+import os
+import shutil
+from kfac_tpu.parallel import multihost
+
+def _with_retries(what, fn):
+    return fn()
+
+def save(state, sdir):
+    if multihost.process_index() == 0 and os.path.exists(sdir):
+        _with_retries('clearing stale dir', lambda: shutil.rmtree(sdir))
+    multihost.barrier('save')
+    write(state, sdir)
+''',
+    ),
+    'KFL305': (
+        # TP: declared save sequence lost its barrier and its wait
+        '''
+SAVE_PROTOCOL = {
+    'machine': 'sequence',
+    'name': 'save',
+    'function': 'save',
+    'steps': (
+        {'op': 'clear', 'rank': 0, 'kind': 'mutate',
+         'effect': 'mutate_dir'},
+        {'op': 'write', 'rank': 'all', 'kind': 'mutate',
+         'effect': 'write_step_dir'},
+        {'op': 'commit', 'rank': 0, 'kind': 'mutate',
+         'effect': 'point_latest'},
+    ),
+}
+
+def save():
+    pass
+''',
+        '''
+from kfac_tpu.parallel import multihost
+
+SAVE_PROTOCOL = {
+    'machine': 'sequence',
+    'name': 'save',
+    'function': 'save',
+    'steps': (
+        {'op': 'clear', 'rank': 0, 'kind': 'mutate',
+         'effect': 'mutate_dir'},
+        {'op': 'barrier', 'rank': 'all', 'kind': 'barrier'},
+        {'op': 'write', 'rank': 'all', 'kind': 'mutate',
+         'effect': 'write_step_dir'},
+        {'op': 'wait', 'rank': 'all', 'kind': 'wait'},
+        {'op': 'commit', 'rank': 0, 'kind': 'mutate',
+         'effect': 'point_latest'},
+    ),
+}
+
+def save(ckptr):
+    multihost.barrier('save')
+    ckptr.wait_until_finished()
+''',
+    ),
 }
 
 
@@ -237,6 +383,11 @@ def main(argv: list[str] | None = None) -> int:
                         help='run the IR rules (KFL201-KFL205): trace '
                              'engine entry points to jaxprs and check the '
                              'lowered program')
+    parser.add_argument('--pod', action='store_true',
+                        help='run the pod rules (KFL301-KFL305): '
+                             'abstractly interpret host control code '
+                             'across virtual ranks and model-check the '
+                             'coordination protocol')
     parser.add_argument('--smoke', action='store_true',
                         help='with --ir/--all: trace only the dense d=64 '
                              'eigen config (bounded wall-clock; the full '
@@ -285,8 +436,13 @@ def main(argv: list[str] | None = None) -> int:
             rules = analysis.get_rules(args.rules.split(','))
         elif args.all:
             rules = analysis.all_rules()
-        elif args.ir:
-            rules = analysis.get_rules(analysis.IR_RULE_CODES)
+        elif args.ir or args.pod:
+            codes = ()
+            if args.ir:
+                codes += analysis.IR_RULE_CODES
+            if args.pod:
+                codes += analysis.POD_RULE_CODES
+            rules = analysis.get_rules(codes)
         else:
             rules = analysis.get_rules(analysis.AST_RULE_CODES)
     except KeyError as exc:
