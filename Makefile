@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults async compress fleet obs tune resilience lint lint-ir lint-pod inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults async compress fleet obs prof tune resilience lint lint-ir lint-pod inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -56,16 +56,32 @@ compress:
 fleet:
 	$(TEST_ENV) $(PY) -m pytest tests/test_fleet.py -q
 
+# measurement-truth layer (docs/OBSERVABILITY.md "Measurement truth"):
+# a real microbench smoke sweep on the CPU backend (fori_loop one-
+# dispatch provenance + latency-floor verdicts over an actual size
+# sweep), the threshold-derivation selftest, a derivation run over the
+# smoke sweep's output, and the measurement + calibration test suites
+prof:
+	$(TEST_ENV) $(PY) tools/tpu_microbench.py --smoke --no-pallas \
+		--sizes 128 256 --iters 2 --rows 512 > /tmp/kfac_prof_micro.jsonl
+	$(TEST_ENV) $(PY) tools/derive_dispatch_tables.py --selftest
+	$(TEST_ENV) $(PY) tools/derive_dispatch_tables.py \
+		/tmp/kfac_prof_micro.jsonl --out /tmp/kfac_prof_tables.json
+	$(TEST_ENV) $(PY) -m pytest tests/test_measurement.py \
+		tests/test_calibration.py -q
+
 # telemetry spine: observability + flight-recorder test suites, the
 # compression/offload suite (its wire-bytes accounting is part of the
 # comms report contract), the self-driving fleet suite (its drift
-# detector consumes the flight recorder's skew columns), the unified
-# static-analysis pass (which includes the named-scope, metric-key,
-# plan-schema, compression-knob and fleet-knob lints as
-# KFL101-KFL103/KFL105/KFL106 plus the IR-tier smoke pass via
+# detector consumes the flight recorder's skew columns), the
+# measurement-truth layer (prof: dispatch-free microbench, threshold
+# derivation, calibration), the unified static-analysis pass (which
+# includes the named-scope, metric-key, plan-schema, compression-knob,
+# fleet-knob and calibration-knob lints as
+# KFL101-KFL103/KFL105/KFL106/KFL108 plus the IR-tier smoke pass via
 # lint-ir), and the kfac_inspect analysis selftest
 # (see docs/OBSERVABILITY.md)
-obs: async lint compress fleet
+obs: async lint compress fleet prof
 	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py \
 		tests/test_flight_recorder.py -q
 	$(PY) tools/kfac_inspect.py --selftest

@@ -660,6 +660,31 @@ def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     _phase('precondition_ms', jax.jit(kfac_m.precondition), kstate, grads)
     result['step_breakdown_ms'] = phases
 
+    # device-truth counterpart of the host-clock phases above: capture a
+    # short profiler trace of annotated steps and attribute its DEVICE
+    # lanes per __kfac_scope__ (the host clocks include dispatch latency;
+    # the trace numbers are chip-side — docs/OBSERVABILITY.md
+    # "Measurement truth"). Empty off-TPU (no device lanes) — host
+    # numbers stand alone and no key is emitted.
+    try:
+        from kfac_tpu.observability import profiler, trace_attrib
+
+        tdir = out_path + '.trace'
+        carry = list(args)
+
+        def _traced_step(i):
+            out = plain_step(*carry)
+            carry[:3] = out[0], out[1], out[2]
+            return out
+
+        profiler.capture_steps(tdir, _traced_step, steps=3)
+        device = trace_attrib.device_breakdown_ms(tdir)
+        if device:
+            phases['device'] = device
+        result['trace_dir'] = tdir
+    except Exception as exc:  # the probe never kills the headline
+        result['trace_attrib_error'] = f'{type(exc).__name__}: {exc}'
+
     # async refresh spike probe, after the headline breakdown is safe on
     # disk — a failure here surfaces as obs_probe_error without losing it
     _atomic_write(out_path, result)
@@ -1190,6 +1215,15 @@ def _tpu_replay() -> dict | None:
     }
 
 
+# measurement provenance stamped into every round record (and echoed by
+# the microbench stages' own header lines). Hardcoded rather than
+# imported from tools/tpu_microbench — importing it pulls in jax at
+# module scope, which the orchestrator must not do before stages pin
+# their own JAX_PLATFORMS; tests/test_measurement.py pins this block to
+# tpu_microbench.HARNESS_VERSION / the default dispatch mode.
+_MEASUREMENT = {'harness_version': 2, 'dispatch_mode': 'fori_loop'}
+
+
 _HEADLINE_KEYS = (
     'platform', 'device_kind', 'model_config', 'clock_check_tflops',
     'sgd_tokens_per_sec', 'eager_tokens_per_sec', 'scan_tokens_per_sec',
@@ -1230,6 +1264,7 @@ def _orchestrate(result: dict) -> None:
     tp = _active_plan()
     if tp is not None:
         result['tuned_plan'] = tp
+    result['measurement'] = dict(_MEASUREMENT)
     _persist(result)
 
     deadline_ts = _T0 + float(os.environ.get('BENCH_DEADLINE_S', '1350'))
@@ -1384,6 +1419,36 @@ def _orchestrate(result: dict) -> None:
             ]
             if errs:
                 entry['pallas_errors'] = errs
+            # measurement provenance: which harness produced these
+            # numbers, and the per-family latency-floor verdicts the
+            # harness appended (docs/OBSERVABILITY.md "Measurement
+            # truth") — a contaminated family means the sweep's absolute
+            # numbers are dispatch floor, not op time
+            header = next(
+                (o for o in ops if 'platform' in o and 'op' not in o), {})
+            entry['measurement'] = {
+                'harness_version': header.get('harness_version', 1),
+                'dispatch_mode': header.get('dispatch_mode', 'legacy'),
+                'dispatches': sorted({
+                    o['dispatches'] for o in ops
+                    if isinstance(o.get('dispatches'), int)
+                }),
+            }
+            floors = {
+                str(o['op']).split('/', 1)[1]: {
+                    k: o[k]
+                    for k in ('contaminated', 'spread', 'expected_ratio',
+                              'floor_ms', 'n')
+                    if k in o
+                }
+                for o in ops if str(o.get('op', '')).startswith('floor/')
+            }
+            if floors:
+                entry['floor_verdicts'] = floors
+                bad = sorted(
+                    f for f, v in floors.items() if v.get('contaminated'))
+                if bad:
+                    entry['floor_contaminated'] = bad
             stages[name] = entry
         _persist(result)
 

@@ -1,4 +1,4 @@
-"""KFL100–KFL107: the migrated docs-vs-code drift linters.
+"""KFL100–KFL108: the migrated docs-vs-code drift linters.
 
 These are ``kind='project'`` rules — unlike the AST rules they import
 the live ``kfac_tpu`` modules and compare real objects (metric schemas,
@@ -426,6 +426,41 @@ def _laplace_knobs() -> list[core.Finding]:
     return _doc_findings('KFL107', LAPLACE_DOC, line, problems)
 
 
+# ------------------------------------------------ KFL108 calibration knobs
+
+
+def check_calibration_knobs(doc_path: str = OBSERVABILITY_DOC) -> list[str]:
+    """Drift between the docs/OBSERVABILITY.md "Calibration knobs" table
+    and the ``CalibrationConfig`` dataclass fields — the knobs of the
+    cost-model calibration monitor."""
+    import dataclasses
+
+    section, _ = doc_section(doc_path, '### Calibration knobs')
+    documented = table_first_cells(section)
+    from kfac_tpu.observability import calibration as calibration_lib
+
+    actual = {
+        f.name
+        for f in dataclasses.fields(calibration_lib.CalibrationConfig)
+    }
+    problems = []
+    for k in sorted(actual - documented):
+        problems.append(f'undocumented config field (add to {doc_path}): {k}')
+    for k in sorted(documented - actual):
+        problems.append(
+            f'documented knob is not a CalibrationConfig field: {k}')
+    return problems
+
+
+def _calibration_knobs() -> list[core.Finding]:
+    try:
+        _, line = doc_section(OBSERVABILITY_DOC, '### Calibration knobs')
+        problems = check_calibration_knobs()
+    except (OSError, ValueError) as exc:
+        return _doc_findings('KFL108', OBSERVABILITY_DOC, 1, [str(exc)])
+    return _doc_findings('KFL108', OBSERVABILITY_DOC, line, problems)
+
+
 # --------------------------------------------------------------- registration
 
 
@@ -524,5 +559,17 @@ core.register(core.Rule(
         'drift bricks saved posteriors and an undocumented knob mis-'
         'calibrates them by folklore',
     check=_laplace_knobs,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL108',
+    name='calibration-knobs-doc',
+    what='drift between the docs/OBSERVABILITY.md "Calibration knobs" '
+         'table and the CalibrationConfig dataclass fields',
+    why='the calibration monitor feeds the fleet controller\'s retune '
+        'trigger; an undocumented (or phantom) knob means the drift '
+        'threshold that re-layouts a live job is configured by folklore',
+    check=_calibration_knobs,
     kind='project',
 ))

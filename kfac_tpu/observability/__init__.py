@@ -14,16 +14,30 @@ Five small modules, one per concern:
   (``StepTraceAnnotation`` per step, one-call capture).
 - :mod:`kfac_tpu.observability.comms` — host-side byte accounting for
   the KAISA transports and size-class padding waste.
+- :mod:`kfac_tpu.observability.trace_attrib` — stdlib parser of the
+  profiler's trace.json.gz into per-step per-scope DEVICE-time
+  breakdowns (the measurement-truth counterpart of host-clock phase
+  timing).
+- :mod:`kfac_tpu.observability.calibration` — live comparison of
+  measured step/spike times against the autotune plan's cost model,
+  with a drift bridge into the fleet controller's retune path.
 
 See docs/OBSERVABILITY.md for the metric-key schema, flight-recorder
 sizing guidance, the postmortem bundle layout, and quickstarts.
 """
 
+from kfac_tpu.observability import calibration
 from kfac_tpu.observability import comms
 from kfac_tpu.observability import flight_recorder
 from kfac_tpu.observability import metrics
 from kfac_tpu.observability import profiler
 from kfac_tpu.observability import sinks
+from kfac_tpu.observability import trace_attrib
+from kfac_tpu.observability.calibration import (
+    CalibrationConfig,
+    CalibrationMonitor,
+    fleet_drift_keys,
+)
 from kfac_tpu.observability.comms import comms_summary
 from kfac_tpu.observability.flight_recorder import (
     FlightRecorderConfig,
@@ -43,8 +57,14 @@ from kfac_tpu.observability.profiler import (
     step_annotation,
 )
 from kfac_tpu.observability.sinks import JSONLWriter, RateLimitedLogger
+from kfac_tpu.observability.trace_attrib import (
+    device_breakdown_ms,
+    step_attribution,
+)
 
 __all__ = [
+    'CalibrationConfig',
+    'CalibrationMonitor',
     'FlightRecorderConfig',
     'FlightRecorderState',
     'JSONLWriter',
@@ -53,10 +73,13 @@ __all__ = [
     'MetricsState',
     'PostmortemWriter',
     'RateLimitedLogger',
+    'calibration',
     'capture_steps',
     'comms',
     'comms_summary',
+    'device_breakdown_ms',
     'drain_flight',
+    'fleet_drift_keys',
     'flight_recorder',
     'metric_keys',
     'metrics',
@@ -64,4 +87,6 @@ __all__ = [
     'profiler',
     'sinks',
     'step_annotation',
+    'step_attribution',
+    'trace_attrib',
 ]

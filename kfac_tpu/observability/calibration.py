@@ -1,0 +1,276 @@
+"""Live cost-model calibration: measured step times vs the tuned plan.
+
+The autotune layer (:mod:`kfac_tpu.autotune`) picks a layout by an
+analytic cost model — ``predicted_step_s`` for steady-state steps and
+``refresh_spike_s`` for the inverse-refresh overshoot. Those predictions
+are only as good as the hardware constants behind them, and nothing in
+the running job checked them: a 2x-wrong model silently ships a 2x-wrong
+layout until the next offline retune.
+
+:class:`CalibrationMonitor` closes that loop. Feed it the wall-clock of
+each optimizer step (and, when you can see them, refresh-spike steps);
+it maintains rolling residual ratios ``measured / predicted``, exposes
+them as ``calib/*`` metric keys for the JSONL / rate-limited-logger
+sinks, folds a headline ``calib/model_error`` into drained
+flight-recorder records, and — via :func:`CalibrationMonitor.wrap_drain`
+— speaks the fleet controller's native drift dialect so a drifted cost
+model drives the EXISTING retune path
+(:class:`kfac_tpu.resilience.fleet.FleetController`) with no new
+controller machinery:
+
+    monitor = calibration.CalibrationMonitor.from_plan(plan)
+    cfg = fleet_lib.FleetConfig(drift_keys=calibration.fleet_drift_keys())
+    fleet = fleet_lib.FleetController(..., drain=monitor.wrap_drain())
+    ...
+    monitor.observe_step(step_wall_s)   # each step, host-side
+
+The bridge works because the controller already thresholds
+``flight_recorder.skew_ratio`` — ``(skew_max - skew_min) / |skew_mean|``
+— per drift key. The monitor injects synthetic skew columns for
+:data:`DRIFT_KEY` with ``min = mean = 1`` and ``max = fold_error``, so
+the ratio the controller sees IS ``fold_error - 1``: a calibration fold
+error of 2x reads as skew 1.0 and trips the default 0.5 threshold the
+same way a real cross-host straggler would. Purely host-side: nothing
+new is jitted, no recompilation (the no-recompile test pins this).
+
+See docs/OBSERVABILITY.md "Measurement truth" for the knob table
+(linted by KFL108) and a worked quickstart.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+from typing import Any, Callable, Iterable, Sequence
+
+#: the headline key the fleet controller thresholds for cost-model drift
+DRIFT_KEY = 'calib/model_error'
+
+
+def fleet_drift_keys(
+    extra: Sequence[str] = ('grad_norm',),
+) -> tuple[str, ...]:
+    """``FleetConfig.drift_keys`` value that adds cost-model drift to the
+    usual straggler keys."""
+    return (DRIFT_KEY,) + tuple(k for k in extra if k != DRIFT_KEY)
+
+
+@dataclasses.dataclass(frozen=True)
+class CalibrationConfig:
+    """Knobs of the cost-model calibration monitor.
+
+    The field set here is pinned to the knob table in
+    docs/OBSERVABILITY.md "Calibration knobs" by lint rule KFL108.
+
+    Args:
+        window: rolling window (in observations) over which step and
+            spike residual ratios are averaged. Small windows react
+            faster; large windows reject step-time noise.
+        warmup_steps: leading ``observe_step`` calls to discard —
+            compile and autotune warmup steps are not model residuals.
+        prefix: metric-key namespace for emitted keys
+            (``<prefix>/step_ratio`` etc.). Change it only if ``calib/``
+            collides with a user metric; the fleet drift bridge's
+            :data:`DRIFT_KEY` stays ``calib/model_error`` regardless.
+    """
+
+    window: int = 32
+    warmup_steps: int = 3
+    prefix: str = 'calib'
+
+    def __post_init__(self) -> None:
+        if self.window < 1:
+            raise ValueError(f'window must be >= 1, got {self.window}')
+        if self.warmup_steps < 0:
+            raise ValueError(
+                f'warmup_steps must be >= 0, got {self.warmup_steps}')
+
+
+def _winner_row(plan: Any) -> dict[str, Any]:
+    """The cost-table row the plan's knobs came from (the winner's full
+    prediction record, including ``refresh_spike_s``)."""
+    knobs = getattr(plan, 'knobs', None)
+    for row in getattr(plan, 'cost_table', None) or []:
+        if isinstance(row, dict) and row.get('knobs') == knobs:
+            return row
+    return {}
+
+
+class CalibrationMonitor:
+    """Rolling comparison of measured step/phase times against a tuned
+    plan's cost-model predictions.
+
+    Residuals are tracked as ratios ``measured / predicted`` (1.0 =
+    perfect model). ``step_ratio()``/``spike_ratio()`` are rolling means
+    over the config window; ``model_error()`` is the fold error
+    ``max(r, 1/r)`` of the step ratio — direction-free, so a model
+    that's 2x optimistic and one that's 2x pessimistic both read 2.0.
+    """
+
+    def __init__(
+        self,
+        predicted_step_s: float,
+        refresh_spike_s: float | None = None,
+        config: CalibrationConfig | None = None,
+    ) -> None:
+        if not (predicted_step_s > 0.0):
+            raise ValueError(
+                f'predicted_step_s must be > 0, got {predicted_step_s}')
+        if refresh_spike_s is not None and refresh_spike_s <= 0.0:
+            # a plan with no spike prediction (sync refresh folded into
+            # the step) just disables the spike channel
+            refresh_spike_s = None
+        self.config = config or CalibrationConfig()
+        self.predicted_step_s = float(predicted_step_s)
+        self.refresh_spike_s = (
+            None if refresh_spike_s is None else float(refresh_spike_s))
+        self._steps: collections.deque[float] = collections.deque(
+            maxlen=self.config.window)
+        self._spikes: collections.deque[float] = collections.deque(
+            maxlen=self.config.window)
+        self._seen = 0
+        self._skipped = 0
+
+    @classmethod
+    def from_plan(
+        cls, plan: Any, config: CalibrationConfig | None = None
+    ) -> 'CalibrationMonitor':
+        """Build from a ``TunedPlan`` (or plan dict / path — anything
+        :func:`kfac_tpu.autotune.plan.as_plan` coerces)."""
+        from kfac_tpu.autotune import plan as plan_lib
+
+        p = plan_lib.as_plan(plan)
+        predicted = float((p.winner or {}).get('predicted_step_s', 0.0))
+        spike = _winner_row(p).get('refresh_spike_s')
+        return cls(
+            predicted_step_s=predicted,
+            refresh_spike_s=None if spike is None else float(spike),
+            config=config,
+        )
+
+    # --------------------------------------------------------- observation
+
+    def observe_step(self, seconds: float) -> float | None:
+        """Record one optimizer step's wall-clock; returns the residual
+        ratio, or None while warming up / for non-finite input."""
+        if self._skipped < self.config.warmup_steps:
+            self._skipped += 1
+            return None
+        seconds = float(seconds)
+        if not math.isfinite(seconds) or seconds <= 0.0:
+            return None
+        ratio = seconds / self.predicted_step_s
+        self._steps.append(ratio)
+        self._seen += 1
+        return ratio
+
+    def observe_spike(self, seconds: float) -> float | None:
+        """Record one refresh-spike overshoot (the wall-clock EXCESS of a
+        refresh step over a steady step); None when the plan predicted
+        no spike."""
+        if self.refresh_spike_s is None:
+            return None
+        seconds = float(seconds)
+        if not math.isfinite(seconds) or seconds <= 0.0:
+            return None
+        ratio = seconds / self.refresh_spike_s
+        self._spikes.append(ratio)
+        return ratio
+
+    # ----------------------------------------------------------- residuals
+
+    @staticmethod
+    def _mean(xs: Iterable[float]) -> float | None:
+        xs = list(xs)
+        return sum(xs) / len(xs) if xs else None
+
+    def step_ratio(self) -> float | None:
+        """Rolling mean ``measured_step / predicted_step`` (None until
+        the first post-warmup observation)."""
+        return self._mean(self._steps)
+
+    def spike_ratio(self) -> float | None:
+        return self._mean(self._spikes)
+
+    def model_error(self) -> float:
+        """Direction-free fold error of the step prediction: ``max(r,
+        1/r)`` of :meth:`step_ratio`; 1.0 with no evidence yet, so an
+        idle monitor never looks drifted."""
+        r = self.step_ratio()
+        if r is None or r <= 0.0:
+            return 1.0
+        return max(r, 1.0 / r)
+
+    # ------------------------------------------------------------ emission
+
+    def record(self) -> dict[str, float]:
+        """Current residuals as a flat metrics record for the sinks
+        (:class:`~kfac_tpu.observability.sinks.JSONLWriter` /
+        ``RateLimitedLogger``). Empty until the first post-warmup
+        observation, so ``writer.write(monitor.record())`` is a safe
+        unconditional call."""
+        r = self.step_ratio()
+        if r is None:
+            return {}
+        p = self.config.prefix
+        rec = {
+            f'{p}/predicted_step_s': self.predicted_step_s,
+            f'{p}/measured_step_s': r * self.predicted_step_s,
+            f'{p}/step_ratio': r,
+            f'{p}/model_error': self.model_error(),
+            f'{p}/n': float(self._seen),
+        }
+        s = self.spike_ratio()
+        if s is not None and self.refresh_spike_s is not None:
+            rec[f'{p}/predicted_spike_s'] = self.refresh_spike_s
+            rec[f'{p}/spike_ratio'] = s
+        return rec
+
+    def annotate(self, record: dict[str, Any]) -> dict[str, Any]:
+        """Fold the ``calib/*`` keys into a drained record in place (and
+        return it) — the flight-recorder headline path."""
+        record.update(self.record())
+        return record
+
+    # -------------------------------------------------------- fleet bridge
+
+    def drift_skew_columns(self) -> dict[str, float]:
+        """Synthetic skew columns encoding the current fold error in the
+        controller's dialect: ``skew_ratio(rec, DRIFT_KEY) ==
+        model_error() - 1``."""
+        fold = self.model_error()
+        return {
+            DRIFT_KEY: fold,
+            f'skew_min/{DRIFT_KEY}': 1.0,
+            f'skew_max/{DRIFT_KEY}': fold,
+            f'skew_mean/{DRIFT_KEY}': 1.0,
+        }
+
+    def wrap_drain(
+        self,
+        drain: Callable[[Any], list[dict[str, Any]]] | None = None,
+    ) -> Callable[[Any], list[dict[str, Any]]]:
+        """A ``FleetController(drain=...)`` callable that stamps every
+        drained flight record with :meth:`drift_skew_columns`, making
+        cost-model drift visible to the controller's existing
+        ``skew_ratio`` thresholding alongside real cross-host skew.
+
+        ``drain=None`` wraps the controller's default
+        (:func:`kfac_tpu.observability.flight_recorder.drain_flight`
+        with the standard skew keys).
+        """
+        if drain is None:
+            from kfac_tpu.observability import flight_recorder as flight_lib
+
+            def drain(state: Any) -> list[dict[str, Any]]:
+                return flight_lib.drain_flight(state)
+
+        def calibrated_drain(state: Any) -> list[dict[str, Any]]:
+            records = drain(state)
+            cols = self.drift_skew_columns()
+            for rec in records:
+                rec.update(cols)
+            return records
+
+        return calibrated_drain

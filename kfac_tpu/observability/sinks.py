@@ -33,10 +33,30 @@ class JSONLWriter:
     a crashed run keeps every completed step's record. Usable as a
     context manager; ``write`` on an empty record is a no-op so callers
     can drain unconditionally.
+
+    Long-running jobs can bound disk usage with ``max_bytes``: when a
+    write would push the current file past the limit, the file is
+    flushed and rotated (``metrics.jsonl`` -> ``metrics.jsonl.1`` -> ...
+    up to ``.max_files``, oldest deleted) BEFORE the record is written,
+    so no single record is ever split across files and the active file
+    always holds the newest records. Rotation is off by default —
+    behavior is unchanged for existing callers.
     """
 
-    def __init__(self, path: str | os.PathLike[str], append: bool = True):
+    def __init__(
+        self,
+        path: str | os.PathLike[str],
+        append: bool = True,
+        max_bytes: int = 0,
+        max_files: int = 3,
+    ):
+        if max_bytes < 0:
+            raise ValueError(f'max_bytes must be >= 0, got {max_bytes}')
+        if max_files < 1:
+            raise ValueError(f'max_files must be >= 1, got {max_files}')
         self.path = os.fspath(path)
+        self.max_bytes = int(max_bytes)
+        self.max_files = int(max_files)
         # telemetry paths are routinely dated subdirectories that don't
         # exist yet (runs/2024-01-01/metrics.jsonl); create them instead
         # of failing the first write of an otherwise healthy run
@@ -45,13 +65,30 @@ class JSONLWriter:
             os.makedirs(parent, exist_ok=True)
         self._file: IO[str] | None = open(self.path, 'a' if append else 'w')
 
+    def _rotate(self) -> None:
+        assert self._file is not None
+        self._file.flush()
+        self._file.close()
+        oldest = f'{self.path}.{self.max_files}'
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for n in range(self.max_files - 1, 0, -1):
+            src = f'{self.path}.{n}'
+            if os.path.exists(src):
+                os.replace(src, f'{self.path}.{n + 1}')
+        os.replace(self.path, f'{self.path}.1')
+        self._file = open(self.path, 'w')
+
     def write(self, record: dict[str, Any]) -> None:
         if not record:
             return
         if self._file is None:
             raise ValueError(f'JSONLWriter({self.path!r}) is closed')
-        self._file.write(
+        line = (
             json.dumps(record, default=_json_default, sort_keys=True) + '\n')
+        if self.max_bytes and self._file.tell() + len(line) > self.max_bytes:
+            self._rotate()
+        self._file.write(line)
         self._file.flush()
 
     def close(self) -> None:
@@ -79,7 +116,9 @@ class RateLimitedLogger:
     always shown first; the remainder is summarized by count.
     """
 
-    _HEADLINE = ('step', 'kl_clip_scale', 'health/skipped_steps')
+    _HEADLINE = (
+        'step', 'kl_clip_scale', 'health/skipped_steps', 'calib/model_error',
+    )
 
     def __init__(
         self,

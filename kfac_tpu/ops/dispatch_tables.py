@@ -1,0 +1,305 @@
+"""Measured dispatch thresholds for the Pallas kernels, as a versioned
+artifact instead of folklore constants.
+
+The ``use_pallas_for`` / ``use_flash_for`` gates used to hard-code their
+win-regime thresholds from one microbench run. ROADMAP item 2 showed why
+that is dangerous: the cov sweep behind them was tunnel-latency
+contaminated (dense f32 flat at 72-83 ms across d=256-2048 — a latency
+floor, not a measurement), so the "5x Pallas win" and the thresholds it
+justified rest on numbers that never touched the work being timed. This
+module makes the derivation itself an artifact:
+
+- :func:`latency_floor_verdict` flags a size sweep whose timings are
+  flat while the underlying work scales — the signature of measuring
+  dispatch latency instead of the op.
+- :func:`derive_tables` turns a microbench JSONL sweep into a threshold
+  table, refusing to move a threshold off its prior when the evidence is
+  floor-contaminated or too thin (fewer than ``min_win_points`` winning
+  sizes), and recording *why* in the artifact's provenance.
+- :func:`load_tables` / the ``threshold_*`` accessors are what the gate
+  modules call at trace time: the committed
+  ``kfac_tpu/ops/dispatch_thresholds.json`` when readable, else the
+  caller's own prior constant (load-or-default — a missing or mangled
+  artifact can never change dispatch behavior, only a committed one).
+
+Stdlib-only on purpose: the gates run inside traces and the derivation
+runs in CI; neither may pull in jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Iterable, Mapping, Sequence
+
+SCHEMA_VERSION = 1
+
+#: committed derivation artifact the gates load (override via env)
+ARTIFACT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), 'dispatch_thresholds.json'
+)
+ENV_VAR = 'KFAC_TPU_DISPATCH_TABLE'
+
+#: prior thresholds (the constants the gates shipped with) — the
+#: derivation's starting point and the load-or-default fallback
+DEFAULTS: dict[str, Any] = {
+    'cov': {'min_dim': 256, 'dtypes': ['float32']},
+    'attn': {'min_sk_dense': 2048},
+}
+
+#: a dtype must win at this many distinct sweep sizes before the
+#: derivation will flip its gate (one anomalous point — e.g. the single
+#: 2722 ms cov_dense_2048_bf16 outlier in the committed evidence — must
+#: not re-open a measured-loss regime)
+MIN_WIN_POINTS = 2
+
+_cache: dict[str, dict[str, Any]] = {}
+
+
+# ------------------------------------------------------------- floor verdict
+
+
+def latency_floor_verdict(
+    sizes: Sequence[float],
+    seconds: Sequence[float],
+    work_exponent: float = 2.0,
+    flat_tol: float = 0.25,
+    min_work_ratio: float = 4.0,
+) -> dict[str, Any] | None:
+    """Flag a size sweep whose timings are flat while the work scales.
+
+    A real op timed across sizes spanning a ``min_work_ratio``-fold work
+    range (work ~ size**work_exponent) cannot be flat; measurements
+    whose max/min spread stays within ``flat_tol`` over such a range are
+    dominated by a fixed per-dispatch latency (tunnel round-trip, queue
+    depth), and every number in the sweep is the floor, not the op.
+
+    Returns None when the series is too short or spans too little work
+    to judge; otherwise a verdict dict with ``contaminated`` (bool),
+    the measured ``spread``, the ``expected_ratio`` of work, and the
+    implied ``floor_ms``.
+    """
+    pts = [
+        (float(s), float(t))
+        for s, t in zip(sizes, seconds)
+        if t is not None and t > 0.0
+    ]
+    if len(pts) < 2:
+        return None
+    pts.sort()
+    lo_s, hi_s = pts[0][0], pts[-1][0]
+    if lo_s <= 0 or hi_s <= lo_s:
+        return None
+    expected = (hi_s / lo_s) ** work_exponent
+    if expected < min_work_ratio:
+        return None  # the sweep never leaves the latency-bound regime
+    times = [t for _, t in pts]
+    spread = max(times) / min(times)
+    flat = spread <= 1.0 + flat_tol
+    return {
+        'contaminated': bool(flat),
+        'spread': round(spread, 3),
+        'expected_ratio': round(expected, 1),
+        'n': len(pts),
+        'floor_ms': round(min(times) * 1e3, 3),
+    }
+
+
+# ------------------------------------------------------------------- loading
+
+
+def _read(path: str) -> dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get('schema') != SCHEMA_VERSION:
+        raise ValueError(
+            f'dispatch table {path!r}: schema '
+            f'{doc.get("schema") if isinstance(doc, dict) else type(doc)} '
+            f'!= {SCHEMA_VERSION}'
+        )
+    return doc
+
+
+def load_tables(path: str | None = None) -> dict[str, Any]:
+    """The committed threshold tables, or ``{}`` when unavailable.
+
+    Resolution order: explicit ``path`` arg, the :data:`ENV_VAR`
+    override, then the committed :data:`ARTIFACT_PATH`. Unreadable or
+    schema-mismatched artifacts degrade to ``{}`` — the gates then run
+    on their built-in priors, which is always a safe dispatch decision.
+    Cached per path (the gates call this at trace time).
+    """
+    resolved = path or os.environ.get(ENV_VAR) or ARTIFACT_PATH
+    if resolved in _cache:
+        return _cache[resolved]
+    try:
+        doc = _read(resolved)
+    except (OSError, ValueError):
+        doc = {}
+    _cache[resolved] = doc
+    return doc
+
+
+def invalidate_cache() -> None:
+    """Drop the load cache (tests point :data:`ENV_VAR` at fixtures)."""
+    _cache.clear()
+
+
+def _get(table: Mapping[str, Any], section: str, key: str) -> Any:
+    sec = table.get(section)
+    if isinstance(sec, Mapping):
+        return sec.get(key)
+    return None
+
+
+def cov_min_dim(default: int) -> int:
+    """Smallest factor dim the triangular cov kernel wins at."""
+    v = _get(load_tables(), 'cov', 'min_dim')
+    return int(v) if isinstance(v, (int, float)) and v > 0 else default
+
+
+def cov_dtypes(default: Sequence[str] = ('float32',)) -> tuple[str, ...]:
+    """Input dtype names (``jnp.dtype(...).name``) the cov kernel wins
+    at."""
+    v = _get(load_tables(), 'cov', 'dtypes')
+    if isinstance(v, (list, tuple)) and all(isinstance(s, str) for s in v):
+        return tuple(v)
+    return tuple(default)
+
+
+def flash_min_sk_dense(default: int) -> int:
+    """Minimum s_k at which dense-path flash beats XLA's fused
+    attention."""
+    v = _get(load_tables(), 'attn', 'min_sk_dense')
+    return int(v) if isinstance(v, (int, float)) and v > 0 else default
+
+
+# ---------------------------------------------------------------- derivation
+
+_COV_RE = re.compile(r'^cov_(dense|pallas)_(\d+)_(f32|bf16)$')
+_ATTN_RE = re.compile(r'^attn_(einsum|flash)_s(\d+)$')
+_DTYPE_NAME = {'f32': 'float32', 'bf16': 'bfloat16'}
+
+
+def _best_ms(ops: Iterable[Mapping[str, Any]]) -> dict[str, float]:
+    """op name -> best (min) reported ms across a possibly-concatenated
+    set of sweeps."""
+    best: dict[str, float] = {}
+    for rec in ops:
+        name, ms = rec.get('op'), rec.get('ms')
+        if not isinstance(name, str) or not isinstance(ms, (int, float)):
+            continue
+        if name not in best or ms < best[name]:
+            best[name] = float(ms)
+    return best
+
+
+def derive_tables(
+    ops: Iterable[Mapping[str, Any]],
+    prior: Mapping[str, Any] | None = None,
+    *,
+    flat_tol: float = 0.25,
+    min_win_points: int = MIN_WIN_POINTS,
+) -> dict[str, Any]:
+    """Derive the threshold tables from microbench JSON records.
+
+    ``ops`` is the parsed JSONL a ``tools/tpu_microbench.py`` sweep
+    prints (``{'op': ..., 'ms': ...}`` lines; provenance fields ride
+    along untouched). The derivation is deliberately conservative:
+
+    - a baseline sweep flagged by :func:`latency_floor_verdict` cannot
+      move its threshold (the numbers measure the tunnel, not the op);
+    - a dtype/length flips its gate only on ``min_win_points`` distinct
+      winning sizes;
+    - everything held back is named in ``provenance`` so the artifact is
+      self-explaining.
+    """
+    prior = dict(prior) if prior is not None else json.loads(
+        json.dumps(DEFAULTS)
+    )
+    best = _best_ms(ops)
+    provenance: dict[str, Any] = {'held': {}, 'contaminated': {}}
+
+    # --- cov: pallas vs dense per dtype ---------------------------------
+    series: dict[str, dict[str, dict[int, float]]] = {}
+    for name, ms in best.items():
+        m = _COV_RE.match(name)
+        if m:
+            impl, d, tag = m.group(1), int(m.group(2)), m.group(3)
+            series.setdefault(tag, {}).setdefault(impl, {})[d] = ms
+    cov_prior = prior.get('cov', DEFAULTS['cov'])
+    min_dim = int(cov_prior.get('min_dim', DEFAULTS['cov']['min_dim']))
+    dtypes = set(cov_prior.get('dtypes', DEFAULTS['cov']['dtypes']))
+    for tag, impls in sorted(series.items()):
+        dense, pallas = impls.get('dense', {}), impls.get('pallas', {})
+        both = sorted(set(dense) & set(pallas))
+        dtype = _DTYPE_NAME[tag]
+        verdict = latency_floor_verdict(
+            both, [dense[d] * 1e-3 for d in both], flat_tol=flat_tol
+        )
+        if verdict and verdict['contaminated']:
+            provenance['contaminated'][f'cov_dense_{tag}'] = verdict
+            provenance['held'][f'cov/{dtype}'] = (
+                'baseline sweep is latency-floor contaminated; threshold '
+                'held at prior'
+            )
+            continue
+        wins = [d for d in both if pallas[d] < dense[d]]
+        if len(wins) < min_win_points:
+            if dtype in dtypes:
+                provenance['held'][f'cov/{dtype}'] = (
+                    f'only {len(wins)} winning size(s) < {min_win_points}; '
+                    'prior stands'
+                )
+            else:
+                provenance['held'][f'cov/{dtype}'] = (
+                    f'{len(wins)} winning size(s) — not enough evidence to '
+                    'open a measured-loss regime'
+                )
+            continue
+        # smallest size from which the kernel wins at every larger
+        # measured size (a clean win regime is a suffix of the sweep)
+        suffix = None
+        for d in sorted(both, reverse=True):
+            if d in wins:
+                suffix = d
+            else:
+                break
+        if suffix is None:
+            dtypes.discard(dtype)
+            continue
+        dtypes.add(dtype)
+        if dtype == 'float32':
+            min_dim = suffix
+        provenance.setdefault('derived', {})[f'cov/{dtype}'] = {
+            'win_from_dim': suffix, 'sizes': both,
+        }
+    # --- attn: flash vs einsum per sequence length ----------------------
+    attn: dict[str, dict[int, float]] = {}
+    for name, ms in best.items():
+        m = _ATTN_RE.match(name)
+        if m:
+            attn.setdefault(m.group(1), {})[int(m.group(2))] = ms
+    attn_prior = prior.get('attn', DEFAULTS['attn'])
+    min_sk = int(
+        attn_prior.get('min_sk_dense', DEFAULTS['attn']['min_sk_dense'])
+    )
+    both = sorted(set(attn.get('einsum', {})) & set(attn.get('flash', {})))
+    wins = [s for s in both if attn['flash'][s] < attn['einsum'][s]]
+    if len(wins) >= min_win_points:
+        min_sk = min(wins)
+        provenance.setdefault('derived', {})['attn/min_sk_dense'] = {
+            'win_from_sk': min_sk, 'sizes': both,
+        }
+    elif both:
+        provenance['held']['attn/min_sk_dense'] = (
+            f'only {len(wins)} winning length(s) < {min_win_points}; '
+            'prior stands'
+        )
+    return {
+        'schema': SCHEMA_VERSION,
+        'cov': {'min_dim': min_dim, 'dtypes': sorted(dtypes)},
+        'attn': {'min_sk_dense': min_sk},
+        'provenance': provenance,
+    }

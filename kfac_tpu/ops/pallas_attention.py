@@ -322,18 +322,22 @@ def use_flash_for(
     staged K+V chunks must fit the VMEM budget, and a trace context GSPMD
     won't auto-partition (:func:`_mosaic_context_ok`); the single-device
     dense path (``dense=True``) additionally requires the measured
-    on-chip win length (``_MIN_FLASH_SK_DENSE``) because its alternative
-    is XLA's fully-fused attention rather than the unfused einsum
-    partials. Overridable via ``KFAC_TPU_PALLAS``
+    on-chip win length — loaded from the committed derivation artifact
+    (:mod:`kfac_tpu.ops.dispatch_tables`) with ``_MIN_FLASH_SK_DENSE``
+    as the load-or-default fallback — because its alternative is XLA's
+    fully-fused attention rather than the unfused einsum partials.
+    Overridable via ``KFAC_TPU_PALLAS``
     (:mod:`kfac_tpu.ops.pallas_gate`)."""
-    from kfac_tpu.ops import pallas_gate
+    from kfac_tpu.ops import dispatch_tables, pallas_gate
 
     return (
         pallas_gate.enabled('attn')
         and jax.default_backend() == 'tpu'
         and s_q % BLOCK_Q == 0
         and s_k % BLOCK_K == 0
-        and (not dense or s_k >= _MIN_FLASH_SK_DENSE)
+        and (not dense or s_k >= dispatch_tables.flash_min_sk_dense(
+            default=_MIN_FLASH_SK_DENSE
+        ))
         and d % 128 == 0
         and 2 * s_k * d * itemsize <= _VMEM_KV_BYTES
         and _mosaic_context_ok()

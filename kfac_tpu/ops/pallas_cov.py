@@ -163,24 +163,35 @@ except TypeError:
 
 
 def use_pallas_for(d: int, dtype) -> bool:
-    """Heuristic: dispatch the kernel only in its measured on-chip win
-    regime (TPU v5 lite, run 20260731_034720, BENCH_TPU.md):
+    """Dispatch the kernel only in its measured on-chip win regime.
+
+    The thresholds come from the committed derivation artifact
+    (:mod:`kfac_tpu.ops.dispatch_tables`,
+    ``kfac_tpu/ops/dispatch_thresholds.json``) with the original
+    measured constants as the load-or-default fallback (TPU v5 lite,
+    run 20260731_034720, BENCH_TPU.md):
 
     - factor dim spanning >= 2 MXU tiles (small factors are
       latency-bound either way), and
     - f32 inputs: the triangular kernel measured ~5x faster than XLA's
       dense contraction at f32 (14-17 ms vs 72-83 ms, d=256..2048) but
       SLOWER at bf16 (127-161 ms vs 77-85 ms), where XLA's native-input
-      matmul beats the kernel's in-VMEM f32 accumulation layout.
+      matmul beats the kernel's in-VMEM f32 accumulation layout. NOTE
+      the f32 baseline sweep is latency-floor contaminated (flat across
+      an 8x size range) — the artifact records that verdict, which is
+      why its thresholds are held at these priors until a clean
+      fori_loop-harness sweep replaces them.
 
     ``dtype`` is required so a call site cannot silently re-open the
     measured-loss bf16 regime. Overridable via ``KFAC_TPU_PALLAS``
     (:mod:`kfac_tpu.ops.pallas_gate`)."""
-    from kfac_tpu.ops import pallas_gate
+    from kfac_tpu.ops import dispatch_tables, pallas_gate
 
     return (
         pallas_gate.enabled('cov')
         and jax.default_backend() == 'tpu'
-        and d >= 2 * TILE
-        and jnp.dtype(dtype) == jnp.float32
+        and d >= dispatch_tables.cov_min_dim(default=2 * TILE)
+        and jnp.dtype(dtype).name in dispatch_tables.cov_dtypes(
+            default=('float32',)
+        )
     )

@@ -1,0 +1,527 @@
+"""Measurement-truth layer, host side: trace attribution + calibration.
+
+Three surfaces, all CPU-runnable:
+
+- :mod:`kfac_tpu.observability.trace_attrib` against the committed
+  mini-trace fixture (``tests/data/mini_trace``): device-lane filtering,
+  identifier-boundary scope matching, group-id and window-fallback step
+  mapping, args-string scope fallback, totals for out-of-window events;
+- :class:`kfac_tpu.observability.calibration.CalibrationMonitor`:
+  residual-ratio math (warmup, rolling window, direction-free fold
+  error), the ``calib/*`` record/annotate emission contract, the
+  rotating :class:`~kfac_tpu.observability.sinks.JSONLWriter`, and the
+  rate-limited logger's ``calib/model_error`` headline;
+- the ISSUE acceptance headline: a doctored 2x cost-model error drives
+  the EXISTING :class:`kfac_tpu.FleetController` through its native
+  drift -> retune -> armed -> migrated path, with no new controller
+  machinery — the monitor only stamps synthetic skew columns into the
+  drain. A perfectly calibrated control run never re-layouts, and the
+  jit cache stays at one entry on both engines (host-side only).
+
+The fleet harness mirrors tests/test_fleet.py (TIGHT_HBM sized between
+the MEM-OPT and COMM-OPT footprints forces the drift retune off the
+canonical COMM-OPT layout).
+"""
+
+import json
+import os
+import warnings
+
+import jax
+import optax
+import pytest
+
+import kfac_tpu
+from kfac_tpu.autotune import model as model_lib
+from kfac_tpu.autotune import search as search_lib
+from kfac_tpu.enums import DistributedStrategy
+from kfac_tpu.observability import calibration, trace_attrib
+from kfac_tpu.observability import flight_recorder as flight_lib
+from kfac_tpu.observability.sinks import JSONLWriter, RateLimitedLogger
+from kfac_tpu.resilience import CheckpointManager
+from kfac_tpu.warnings import reset_fleet_warnings, reset_layout_warnings
+from testing import models
+
+FIXTURE = os.path.join(os.path.dirname(__file__), 'data', 'mini_trace')
+
+WORLD = 8
+
+#: see tests/test_fleet.py — between MEM-OPT and COMM-OPT footprints, so
+#: the model-only retune must leave the canonical COMM-OPT layout
+TIGHT_HBM = model_lib.HardwareSpec(hbm_bytes=8000.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_warning_state():
+    reset_fleet_warnings()
+    reset_layout_warnings()
+    yield
+    reset_fleet_warnings()
+    reset_layout_warnings()
+
+
+# ----------------------------------------------------- trace attribution
+
+
+def test_fixture_step_attribution_exact():
+    """The committed mini-trace parses to pinned numbers: device lanes
+    only, boundary-checked scopes, group-id + window step mapping."""
+    out = trace_attrib.step_attribution(FIXTURE)
+    assert out['n_steps'] == 2
+    assert out['n_device_events'] == 7
+    assert len(out['trace_files']) == 1
+    # step 7: group_id events, including the dist_kfac.precondition one
+    # that must NOT be miscounted as kfac.precondition (boundary check),
+    # and the host-lane kfac.update_factors impostor that must be ignored
+    assert out['steps'][7] == {
+        'dist_kfac.precondition': 0.1,
+        'kfac.precondition': 0.2,
+        'kfac.update_factors': 0.3,
+    }
+    # step 8: window-fallback (no group_id), args long_name fallback for
+    # the fusion event, and the unattributable infeed op
+    assert out['steps'][8] == {
+        'kfac.precondition': 0.05,
+        'kfac.update_inverses': 0.4,
+        'unattributed': 0.03,
+    }
+    # the out-of-window async refresh counts toward totals only
+    assert out['total_ms'] == {
+        'dist_kfac.precondition': 0.1,
+        'kfac.async_refresh': 0.8,
+        'kfac.precondition': 0.25,
+        'kfac.update_factors': 0.3,
+        'kfac.update_inverses': 0.4,
+        'unattributed': 0.03,
+    }
+    # mean over the two annotated steps, async refresh excluded
+    assert out['per_step_ms'] == {
+        'kfac.update_factors': 0.15,
+        'kfac.precondition': 0.125,
+        'dist_kfac.precondition': 0.05,
+        'kfac.update_inverses': 0.2,
+        'unattributed': 0.015,
+    }
+
+
+def test_device_breakdown_is_per_step_view():
+    assert (trace_attrib.device_breakdown_ms(FIXTURE)
+            == trace_attrib.step_attribution(FIXTURE)['per_step_ms'])
+
+
+def test_match_scope_boundary_and_depth():
+    # identifier boundary: the kfac.* substring inside dist_kfac.* does
+    # not count as a kfac.* scope entry
+    assert (trace_attrib.match_scope('jit(f)/dist_kfac.update_factors/x')
+            == 'dist_kfac.update_factors')
+    assert trace_attrib.match_scope('a_kfac.step') is None
+    # nested scopes attribute to the innermost (deepest occurrence)
+    assert (trace_attrib.match_scope('kfac.step/kfac.precondition/fusion')
+            == 'kfac.precondition')
+    assert trace_attrib.match_scope('fusion.123') is None
+
+
+def test_find_trace_files_resolution(tmp_path):
+    files = trace_attrib.find_trace_files(FIXTURE)
+    assert len(files) == 1 and files[0].endswith('trace.json.gz')
+    # a direct file path passes through
+    assert trace_attrib.find_trace_files(files[0]) == [files[0]]
+    # a dir with no traces is empty, not an error
+    assert trace_attrib.find_trace_files(tmp_path) == []
+
+
+def test_host_only_trace_yields_empty_breakdown(tmp_path):
+    """A CPU-backend capture (no device lanes) is a graceful no-op."""
+    import gzip
+
+    doc = {'traceEvents': [
+        {'ph': 'M', 'pid': 1, 'name': 'process_name',
+         'args': {'name': '/host:CPU'}},
+        {'ph': 'X', 'pid': 1, 'name': 'kfac.update_factors',
+         'ts': 0, 'dur': 100},
+    ]}
+    path = tmp_path / 'host.trace.json.gz'
+    with gzip.open(path, 'wt') as f:
+        json.dump(doc, f)
+    out = trace_attrib.step_attribution(tmp_path)
+    assert out['n_device_events'] == 0
+    assert trace_attrib.device_breakdown_ms(tmp_path) == {}
+
+
+# ------------------------------------------------------ JSONL rotation
+
+
+def _lines(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_jsonl_rotation_off_by_default(tmp_path):
+    path = tmp_path / 'metrics.jsonl'
+    with JSONLWriter(path) as w:
+        for i in range(50):
+            w.write({'step': i, 'pad': 'x' * 64})
+    assert len(_lines(path)) == 50
+    assert not os.path.exists(f'{path}.1')
+
+
+def test_jsonl_rotation_shifts_and_caps(tmp_path):
+    path = str(tmp_path / 'metrics.jsonl')
+    rec = {'step': 0, 'pad': 'x' * 40}
+    size = len(json.dumps(rec, sort_keys=True)) + 1
+    # room for exactly two records per file
+    with JSONLWriter(path, max_bytes=2 * size + 1, max_files=2) as w:
+        for i in range(9):
+            w.write({'step': i, 'pad': 'x' * 40})
+    # newest records in the active file, shifted history behind it,
+    # oldest files deleted at the max_files cap
+    assert [r['step'] for r in _lines(path)] == [8]
+    assert [r['step'] for r in _lines(f'{path}.1')] == [6, 7]
+    assert [r['step'] for r in _lines(f'{path}.2')] == [4, 5]
+    assert not os.path.exists(f'{path}.3')
+
+
+def test_jsonl_rotation_never_splits_a_record(tmp_path):
+    path = str(tmp_path / 'metrics.jsonl')
+    with JSONLWriter(path, max_bytes=64, max_files=4) as w:
+        for i in range(12):
+            w.write({'step': i, 'pad': 'y' * (i * 7)})
+    # every surviving line — in every generation — parses whole, and the
+    # step sequence across generations is a contiguous suffix
+    steps = []
+    for suffix in ('.4', '.3', '.2', '.1', ''):
+        f = path + suffix
+        if os.path.exists(f):
+            steps.extend(r['step'] for r in _lines(f))
+    assert steps == list(range(12 - len(steps), 12))
+
+
+def test_jsonl_oversized_record_written_whole(tmp_path):
+    path = str(tmp_path / 'metrics.jsonl')
+    with JSONLWriter(path, max_bytes=16, max_files=2) as w:
+        w.write({'huge': 'z' * 200})
+    assert _lines(path) == [{'huge': 'z' * 200}]
+
+
+def test_jsonl_rotation_validation(tmp_path):
+    with pytest.raises(ValueError, match='max_bytes'):
+        JSONLWriter(tmp_path / 'a.jsonl', max_bytes=-1)
+    with pytest.raises(ValueError, match='max_files'):
+        JSONLWriter(tmp_path / 'a.jsonl', max_files=0)
+
+
+# -------------------------------------------------- calibration monitor
+
+
+def test_calibration_config_validation():
+    cfg = calibration.CalibrationConfig()
+    assert (cfg.window, cfg.warmup_steps, cfg.prefix) == (32, 3, 'calib')
+    with pytest.raises(ValueError, match='window'):
+        calibration.CalibrationConfig(window=0)
+    with pytest.raises(ValueError, match='warmup_steps'):
+        calibration.CalibrationConfig(warmup_steps=-1)
+
+
+def test_monitor_rejects_bad_predictions():
+    with pytest.raises(ValueError, match='predicted_step_s'):
+        calibration.CalibrationMonitor(0.0)
+    # a non-positive spike prediction just disables the spike channel
+    mon = calibration.CalibrationMonitor(0.01, refresh_spike_s=0.0)
+    assert mon.refresh_spike_s is None
+    assert mon.observe_spike(1.0) is None
+
+
+def test_monitor_warmup_and_empty_record():
+    cfg = calibration.CalibrationConfig(warmup_steps=2)
+    mon = calibration.CalibrationMonitor(0.01, config=cfg)
+    assert mon.record() == {}
+    assert mon.observe_step(0.02) is None
+    assert mon.observe_step(0.02) is None
+    assert mon.record() == {}  # still no evidence
+    assert mon.model_error() == 1.0  # idle monitor never looks drifted
+    assert mon.observe_step(0.02) == pytest.approx(2.0)
+    assert mon.record() != {}
+
+
+def test_monitor_residual_math_and_fold_symmetry():
+    cfg = calibration.CalibrationConfig(warmup_steps=0, window=8)
+    mon = calibration.CalibrationMonitor(0.01, config=cfg)
+    for _ in range(3):
+        mon.observe_step(0.02)
+    assert mon.step_ratio() == pytest.approx(2.0)
+    assert mon.model_error() == pytest.approx(2.0)
+    # a 2x-pessimistic model reads the same fold error
+    pess = calibration.CalibrationMonitor(0.01, config=cfg)
+    pess.observe_step(0.005)
+    assert pess.step_ratio() == pytest.approx(0.5)
+    assert pess.model_error() == pytest.approx(2.0)
+
+
+def test_monitor_rolling_window_forgets():
+    cfg = calibration.CalibrationConfig(warmup_steps=0, window=2)
+    mon = calibration.CalibrationMonitor(1.0, config=cfg)
+    mon.observe_step(1.0)
+    mon.observe_step(1.0)
+    mon.observe_step(3.0)
+    mon.observe_step(3.0)
+    assert mon.step_ratio() == pytest.approx(3.0)
+
+
+def test_monitor_rejects_nonfinite_and_nonpositive():
+    cfg = calibration.CalibrationConfig(warmup_steps=0)
+    mon = calibration.CalibrationMonitor(0.01, config=cfg)
+    for bad in (float('nan'), float('inf'), 0.0, -1.0):
+        assert mon.observe_step(bad) is None
+    assert mon.step_ratio() is None
+
+
+def test_monitor_record_and_annotate_contract():
+    cfg = calibration.CalibrationConfig(warmup_steps=0, window=4)
+    mon = calibration.CalibrationMonitor(0.01, refresh_spike_s=0.5,
+                                         config=cfg)
+    mon.observe_step(0.02)
+    mon.observe_step(0.02)
+    assert mon.observe_spike(1.0) == pytest.approx(2.0)
+    rec = mon.record()
+    assert set(rec) == {
+        'calib/predicted_step_s', 'calib/measured_step_s',
+        'calib/step_ratio', 'calib/model_error', 'calib/n',
+        'calib/predicted_spike_s', 'calib/spike_ratio',
+    }
+    assert rec['calib/predicted_step_s'] == pytest.approx(0.01)
+    assert rec['calib/measured_step_s'] == pytest.approx(0.02)
+    assert rec['calib/step_ratio'] == pytest.approx(2.0)
+    assert rec['calib/model_error'] == pytest.approx(2.0)
+    assert rec['calib/n'] == 2.0
+    assert rec['calib/spike_ratio'] == pytest.approx(2.0)
+    # annotate folds the same keys into a drained record, in place
+    drained = {'step': 5, 'loss': 0.1}
+    out = mon.annotate(drained)
+    assert out is drained
+    assert drained['calib/model_error'] == pytest.approx(2.0)
+    assert drained['step'] == 5
+    # custom prefix renames the metric namespace...
+    alt = calibration.CalibrationMonitor(
+        0.01, config=calibration.CalibrationConfig(
+            warmup_steps=0, prefix='cm'))
+    alt.observe_step(0.02)
+    assert 'cm/model_error' in alt.record()
+    # ...but the fleet bridge's drift key stays fixed
+    assert calibration.DRIFT_KEY in alt.drift_skew_columns()
+
+
+def test_monitor_from_real_tuned_plan():
+    _, _, _, bare, _ = _setup()
+    plan = _comm_opt_plan(bare)
+    mon = calibration.CalibrationMonitor.from_plan(plan)
+    assert mon.predicted_step_s == pytest.approx(
+        plan.winner['predicted_step_s'])
+    assert mon.predicted_step_s > 0
+    row = calibration._winner_row(plan)
+    assert row and row.get('knobs') == plan.knobs
+    spike = row.get('refresh_spike_s')
+    if spike is not None and spike > 0:
+        assert mon.refresh_spike_s == pytest.approx(spike)
+    else:
+        assert mon.refresh_spike_s is None
+    # plan dicts coerce through as_plan too
+    mon2 = calibration.CalibrationMonitor.from_plan(plan.to_json())
+    assert mon2.predicted_step_s == pytest.approx(mon.predicted_step_s)
+
+
+def test_fleet_drift_keys_dedup():
+    assert calibration.fleet_drift_keys() == (
+        'calib/model_error', 'grad_norm')
+    assert calibration.fleet_drift_keys(
+        ('calib/model_error', 'loss')) == ('calib/model_error', 'loss')
+
+
+def test_drift_skew_columns_speak_controller_dialect():
+    cfg = calibration.CalibrationConfig(warmup_steps=0)
+    mon = calibration.CalibrationMonitor(0.01, config=cfg)
+    for _ in range(2):
+        mon.observe_step(0.02)
+    cols = mon.drift_skew_columns()
+    # the controller's own skew_ratio reads fold_error - 1 off them
+    assert flight_lib.skew_ratio(cols, calibration.DRIFT_KEY) == (
+        pytest.approx(mon.model_error() - 1.0))
+    # and an uncalibrated monitor reads as zero skew (no false drift)
+    idle = calibration.CalibrationMonitor(0.01, config=cfg)
+    assert flight_lib.skew_ratio(
+        idle.drift_skew_columns(), calibration.DRIFT_KEY) == 0.0
+
+
+def test_wrap_drain_stamps_every_record():
+    cfg = calibration.CalibrationConfig(warmup_steps=0)
+    mon = calibration.CalibrationMonitor(0.01, config=cfg)
+    mon.observe_step(0.02)
+    drain = mon.wrap_drain(lambda state: [{'step': 1}, {'step': 2}])
+    records = drain(None)
+    assert len(records) == 2
+    for rec in records:
+        assert rec[calibration.DRIFT_KEY] == pytest.approx(2.0)
+        assert rec[f'skew_max/{calibration.DRIFT_KEY}'] == (
+            pytest.approx(2.0))
+        assert rec[f'skew_mean/{calibration.DRIFT_KEY}'] == 1.0
+
+
+def test_rate_limited_logger_headlines_model_error(caplog):
+    assert 'calib/model_error' in RateLimitedLogger._HEADLINE
+    rl = RateLimitedLogger(min_interval_s=0.0)
+    with caplog.at_level('INFO'):
+        assert rl.emit({'step': 3, 'calib/model_error': 2.0,
+                        'calib/step_ratio': 2.0})
+    assert 'calib/model_error=2' in caplog.text
+
+
+def test_monitor_records_flow_through_jsonl(tmp_path):
+    cfg = calibration.CalibrationConfig(warmup_steps=0)
+    mon = calibration.CalibrationMonitor(0.01, config=cfg)
+    path = tmp_path / 'metrics.jsonl'
+    with JSONLWriter(path) as w:
+        w.write(mon.record())  # empty pre-evidence record is a no-op
+        mon.observe_step(0.02)
+        w.write(mon.record())
+    lines = _lines(path)
+    assert len(lines) == 1
+    assert lines[0]['calib/model_error'] == pytest.approx(2.0)
+
+
+# ------------------------------------------------- fleet drift headline
+
+
+def _setup():
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+
+    def loss_fn(p, model_state, batch):
+        bx, by = batch
+        pred = m.apply({'params': p}, bx)
+        return jax.numpy.mean((pred - by) ** 2), model_state
+
+    def bare():
+        return kfac_tpu.KFACPreconditioner(
+            registry=reg, kl_clip=None, damping=1e-3, flight=8
+        )
+
+    return m, (x, y), params, bare, loss_fn
+
+
+def _comm_opt_plan(bare):
+    return search_lib.autotune(
+        bare(), measure=False, world=WORLD,
+        fractions=(1.0,), granularities=(1,),
+    )
+
+
+def _calibrated_fleet(directory, bare, loss_fn, plan, monitor):
+    cfg = kfac_tpu.FleetConfig(
+        check_every=2, drift_keys=calibration.fleet_drift_keys(),
+        drift_threshold=0.5, drift_window=2, drift_patience=1,
+        cooldown_steps=1,
+    )
+    mgr = CheckpointManager(
+        directory, save_interval_steps=4, keep=3,
+        install_signals=(), async_save=False,
+    )
+    ctrl = kfac_tpu.FleetController(
+        mgr, cfg, plan=plan, hardware=TIGHT_HBM,
+        drain=monitor.wrap_drain(),
+    )
+    trainer = kfac_tpu.Trainer(
+        loss_fn=loss_fn, optimizer=optax.sgd(0.05), kfac=bare(), fleet=ctrl,
+    )
+    return trainer, ctrl
+
+
+def test_cost_model_drift_drives_existing_retune_path(tmp_path):
+    """The ISSUE acceptance headline: a doctored 2x cost-model error —
+    nothing else — walks the UNMODIFIED FleetController through drift ->
+    retune -> armed -> migrated, while a perfectly calibrated control
+    run on the same plan never re-layouts."""
+    m, batch, params, bare, loss_fn = _setup()
+    plan = _comm_opt_plan(bare)
+    ccfg = calibration.CalibrationConfig(warmup_steps=0, window=4)
+
+    drifted = calibration.CalibrationMonitor.from_plan(plan, ccfg)
+    calm = calibration.CalibrationMonitor.from_plan(plan, ccfg)
+    for _ in range(4):
+        # steps measure 2x the model's prediction vs spot-on
+        drifted.observe_step(2.0 * drifted.predicted_step_s)
+        calm.observe_step(calm.predicted_step_s)
+    assert drifted.model_error() == pytest.approx(2.0)
+    assert calm.model_error() == pytest.approx(1.0)
+
+    trainer, ctrl = _calibrated_fleet(
+        tmp_path / 'a', bare, loss_fn, plan, drifted)
+    control, ctrl_c = _calibrated_fleet(
+        tmp_path / 'b', bare, loss_fn, plan, calm)
+    assert ctrl.engine.grad_workers == WORLD  # COMM-OPT until drift
+
+    state, cstate = trainer.init(params), control.init(params)
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        for _ in range(6):
+            state, _ = trainer.step(state, batch)
+            cstate, _ = control.step(cstate, batch)
+
+    names = [e['event'] for e in ctrl.events]
+    assert names[:4] == ['drift', 'retune', 'armed', 'migrated']
+    assert ctrl.stats['migrations'] == 1
+    # the tight HBM budget forced the retune off the canonical layout
+    assert ctrl.engine.grad_workers == 1
+    assert ctrl.engine.strategy == DistributedStrategy.MEM_OPT
+    # the calibrated pod never moves
+    assert ctrl_c.events == []
+    assert ctrl_c.engine.grad_workers == WORLD
+
+
+# ------------------------------------------------- no-recompile pinning
+
+
+def _observe_loop(kfac_like, run, params, batch, monitor, n=5):
+    state = kfac_like.init()
+    step = jax.jit(kfac_like.step)
+    for _ in range(n):
+        (_, _), grads, stats = run(params, batch)
+        state, _ = step(state, grads, stats)
+        monitor.observe_step(0.02)
+        monitor.annotate({'step': 1})
+    return step
+
+
+def test_calibration_is_jit_invisible_dense():
+    """Observing/annotating every step is purely host-side: one cache
+    entry, exactly like an uninstrumented run."""
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1))
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(registry=reg, metrics=True)
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(
+        models.mse_loss(m))
+    mon = calibration.CalibrationMonitor(
+        0.01, config=calibration.CalibrationConfig(warmup_steps=0))
+    step = _observe_loop(kfac, run, params, (x, y), mon)
+    assert step._cache_size() == 1
+    assert mon.model_error() == pytest.approx(2.0)
+
+
+def test_calibration_is_jit_invisible_distributed():
+    from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+
+    mesh = kaisa_mesh(grad_worker_fraction=0.5)
+    m = models.TinyModel(hidden=8, out=4)
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=64, dim=6)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    cfg = kfac_tpu.KFACPreconditioner(registry=reg, metrics=True)
+    dk = DistributedKFAC(config=cfg, mesh=mesh)
+    run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(
+        models.mse_loss(m))
+    mon = calibration.CalibrationMonitor(
+        0.01, config=calibration.CalibrationConfig(warmup_steps=0))
+    step = _observe_loop(dk, run, params, (x, y), mon, n=3)
+    assert step._cache_size() == 1

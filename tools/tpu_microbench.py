@@ -24,6 +24,22 @@ sys.path.insert(0, _common.repo_root())
 import jax
 import jax.numpy as jnp
 
+#: measurement-harness version stamped on every reported line. v1 was the
+#: per-iteration host dispatch loop; v2 is the one-dispatch in-jit
+#: fori_loop chain (bench.py's round records carry this so a number is
+#: attributable to the harness that produced it — keep bench.py's
+#: _MEASUREMENT copy in sync, tests/test_measurement.py pins the pair).
+HARNESS_VERSION = 2
+
+#: env override for the dispatch mode ('fori_loop' | 'legacy'); the
+#: --dispatch flag sets it for child measurements too
+DISPATCH_ENV = 'KFAC_MICROBENCH_DISPATCH'
+
+
+def _dispatch_mode():
+    mode = os.environ.get(DISPATCH_ENV, 'fori_loop')
+    return mode if mode in ('fori_loop', 'legacy') else 'fori_loop'
+
 
 def _scale(tree, c):
     """Multiply every floating leaf of a pytree by c (ints pass through:
@@ -61,8 +77,64 @@ def _chain(tree, out):
     return jax.tree_util.tree_map(add_once, tree)
 
 
-def timeit(fn, *args, iters=20, warmup=1):
-    """Time fn with an INPUT-VARYING, ITERATION-CHAINED first argument.
+class Timing(float):
+    """Measured seconds plus how they were measured.
+
+    Arithmetic degrades to plain float; ``report`` lifts ``provenance``
+    (harness version, dispatch mode, dispatch count) onto the JSON line
+    so every persisted number is self-labeling.
+    """
+
+    def __new__(cls, seconds, provenance=None):
+        self = super().__new__(cls, seconds)
+        self.provenance = dict(provenance or {})
+        return self
+
+
+def _chain_body(fn, first, rest, warmup):
+    """One chained perturbed iteration: scale the base input by an
+    iteration-dependent 1% (offset past the warmup range — reusing a
+    warmup scale plus _chain's exact 0.0 would hand the memoizer a
+    bitwise-identical input), feed a zero derived from the previous
+    output into it, run fn. Works with a Python int i (legacy host loop)
+    or a traced i (in-jit fori_loop) — the SAME math either way, which
+    is what tests/test_measurement.py pins.
+    """
+
+    def body(i, out):
+        c = 1.0 + 0.01 * (warmup + i + 1.0)
+        return fn(_chain(_scale(first, c), out), *rest)
+
+    return body
+
+
+def _warm(fn, first, rest, warmup):
+    out = None
+    for i in range(warmup):
+        out = fn(_scale(first, 1.0 + 0.01 * (i + 1)), *rest)
+    return out
+
+
+def chain_result(fn, *args, iters=20, warmup=1, mode='fori_loop'):
+    """Final output of the chained perturbed iteration sequence, via
+    either dispatch mode — the equivalence oracle for the two timeit
+    paths (no timing, just the math)."""
+    first, rest = args[0], args[1:]
+    out = _warm(fn, first, rest, warmup)
+    body = _chain_body(fn, first, rest, warmup)
+    if mode == 'fori_loop':
+        looped = jax.jit(
+            lambda out0: jax.lax.fori_loop(0, iters, body, out0)
+        )
+        return looped(out)
+    for i in range(iters):
+        out = body(i, out)
+    return out
+
+
+def timeit(fn, *args, iters=20, warmup=1, mode=None):
+    """Time fn over ITERATION-CHAINED perturbed iterations, ONE dispatch
+    per measurement.
 
     Two axon-pool hazards, both measured on the real tunnel:
     - the backend memoizes repeated identical computations (an 8-deep
@@ -74,21 +146,53 @@ def timeit(fn, *args, iters=20, warmup=1):
       loop still reported 8.4 PFLOP/s on one v5e chip (~20x peak).
       Feeding a zero derived from iteration i's output into iteration
       i+1's input serializes the chain without changing the math.
+
+    The v1 harness ran that chain as iters host dispatches, so every
+    number still carried one tunnel round-trip per iteration — the
+    latency floor that flattened the cov sweep (ROADMAP item 2). v2
+    moves the chain INSIDE jit as a ``lax.fori_loop``: the whole
+    measurement is one dispatch, so per-iteration time contains at most
+    1/iters of the dispatch latency. Callables that cannot trace under
+    jit (AOT-compiled executables, host callbacks) fall back to the
+    legacy host loop; the returned :class:`Timing` records which mode
+    actually ran and how many dispatches the timed region contained.
     """
+    mode = mode or _dispatch_mode()
     first, rest = args[0], args[1:]
-    out = None
-    for i in range(warmup):
-        out = fn(_scale(first, 1.0 + 0.01 * (i + 1)), *rest)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        # scales offset past the warmup range: reusing warmup's scale for
-        # timed iteration 0 (plus _chain's exact 0.0) would hand the
-        # memoizer a bitwise-identical input and a free cache hit
-        c = 1.0 + 0.01 * (warmup + i + 1)
-        out = fn(_chain(_scale(first, c), out), *rest)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    out0 = _warm(fn, first, rest, warmup)
+    jax.block_until_ready(out0)
+    body = _chain_body(fn, first, rest, warmup)
+    looped = None
+    if mode == 'fori_loop' and warmup >= 1:
+        try:
+            looped = jax.jit(
+                lambda o0, f, r: jax.lax.fori_loop(
+                    0, iters, _chain_body(fn, f, r, warmup), o0
+                )
+            )
+            # untimed compile + warm run of the whole chain
+            jax.block_until_ready(looped(out0, first, rest))
+        except Exception:  # noqa: BLE001 - e.g. AOT executables don't trace
+            looped = None
+    if looped is not None:
+        t0 = time.perf_counter()
+        jax.block_until_ready(looped(out0, first, rest))
+        seconds = (time.perf_counter() - t0) / iters
+        mode, dispatches = 'fori_loop', 1
+    else:
+        out = out0
+        t0 = time.perf_counter()
+        for i in range(iters):
+            out = body(i, out)
+        jax.block_until_ready(out)
+        seconds = (time.perf_counter() - t0) / iters
+        mode, dispatches = 'legacy', iters
+    return Timing(seconds, {
+        'harness_version': HARNESS_VERSION,
+        'dispatch_mode': mode,
+        'dispatches': dispatches,
+        'iters': iters,
+    })
 
 
 def measured(name, thunk, iters, post=None):
@@ -121,8 +225,37 @@ def announce(name):
 
 
 def report(name, seconds, **extra):
-    print(json.dumps({'op': name, 'ms': round(seconds * 1e3, 3), **extra}),
-          flush=True)
+    rec = {'op': name, 'ms': round(seconds * 1e3, 3)}
+    rec.update(getattr(seconds, 'provenance', None) or {})
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def report_floor_verdicts(sweeps):
+    """Latency-floor check per sweep family, one ``floor/<family>`` JSON
+    line each: a family whose timings stayed flat while the sweep's work
+    scaled is contaminated — every number in it is the dispatch floor,
+    not the op (measured: cov_dense f32 flat at 72-83 ms across
+    d=256-2048 under the v1 host-loop harness). bench.py lifts these
+    verdicts into the round record so contaminated numbers self-label.
+
+    ``sweeps``: family -> (work_exponent, [(size, seconds|None), ...]).
+    Returns the verdicts keyed by family.
+    """
+    from kfac_tpu.ops import dispatch_tables
+
+    verdicts = {}
+    for family, (exponent, points) in sorted(sweeps.items()):
+        sizes = [s for s, t in points if t is not None]
+        times = [t for _, t in points if t is not None]
+        verdict = dispatch_tables.latency_floor_verdict(
+            sizes, times, work_exponent=exponent
+        )
+        if verdict is not None:
+            verdicts[family] = verdict
+            print(json.dumps({'op': f'floor/{family}', **verdict}),
+                  flush=True)
+    return verdicts
 
 
 def newton_schulz_inverse(a, damping, iters=25):
@@ -356,6 +489,15 @@ def main():
                    help='interleaved-1F1B schedule bubble fractions '
                    '(pure schedule math, no device work)')
     p.add_argument('--skip-factor-ops', action='store_true')
+    p.add_argument('--dispatch', choices=['fori_loop', 'legacy'],
+                   help='measurement dispatch mode: fori_loop (default; '
+                   'ONE dispatch per measurement, the chain runs in-jit) '
+                   'or legacy (v1 per-iteration host dispatches, kept '
+                   'for A/B-ing the harness itself)')
+    p.add_argument('--smoke', action='store_true',
+                   help='CI-sized pass: shrink the clock-check matmul and '
+                   'skip the attention A/B so the sweep runs in seconds '
+                   'on a CPU host (make prof)')
     p.add_argument('--no-pallas', action='store_true',
                    help='skip the Pallas kernels (cov + flash attention): '
                    'measure only validated XLA ops — the safe first pass '
@@ -365,17 +507,28 @@ def main():
                    'oracles (on-chip validation pass; run after the safe '
                    'ops have succeeded)')
     args = p.parse_args()
+    if args.dispatch:
+        os.environ[DISPATCH_ENV] = args.dispatch
 
     dev = jax.devices()[0]
     print(json.dumps({'platform': dev.platform,
-                      'device_kind': getattr(dev, 'device_kind', '')}),
+                      'device_kind': getattr(dev, 'device_kind', ''),
+                      'harness_version': HARNESS_VERSION,
+                      'dispatch_mode': _dispatch_mode()}),
           flush=True)
 
     run_pallas = not args.no_pallas
     xla_ops = not args.pallas_only
+    #: family -> (work exponent wrt the swept size, [(size, seconds)]);
+    #: fed to the latency-floor check after the sweep
+    sweeps: dict = {}
+
+    def track(family, exponent, size, t):
+        sweeps.setdefault(family, (exponent, []))[1].append((size, t))
+        return t
 
     # --- clock validation: known-FLOPs matmul chain -----------------------
-    n = 4096
+    n = 512 if args.smoke else 4096
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (n, n), jnp.bfloat16)
 
@@ -386,10 +539,10 @@ def main():
             x = x @ a
         return x
 
-    announce('matmul4096_bf16_chain8')
+    announce(f'matmul{n}_bf16_chain8')
     t = timeit(mm_chain, a, iters=args.iters)
     flops = 8 * 2 * n**3
-    report('matmul4096_bf16_chain8', t, tflops=round(flops / t / 1e12, 1))
+    report(f'matmul{n}_bf16_chain8', t, tflops=round(flops / t / 1e12, 1))
 
     # --- flash attention kernel vs einsum attention (TPU only: the
     # kernel needs real Mosaic, and the einsum path at this size is
@@ -398,36 +551,39 @@ def main():
     from kfac_tpu.ops import pallas_attention as pa
 
     on_tpu = dev.platform == 'tpu'
-    b, s, h, hd = (4, 2048, 4, 128) if on_tpu else (1, 256, 1, 128)
-    kq, kk, kv = jax.random.split(jax.random.PRNGKey(9), 3)
-    qkv = tuple(
-        jax.random.normal(kx, (b, s, h, hd), jnp.bfloat16)
-        for kx in (kq, kk, kv)
-    )
-    dense_att = jax.jit(
-        lambda q, k, v: att._finish(pa.attend_partials_einsum(q, k, v, 0, 0, True))
-    )
-    announce(f'attn_einsum_s{s}')
-    t = timeit(dense_att, *qkv, iters=args.iters)
-    report(f'attn_einsum_s{s}', t)
-    if on_tpu and run_pallas:
-        flash = jax.jit(
+    if not args.smoke:
+        b, s, h, hd = (4, 2048, 4, 128) if on_tpu else (1, 256, 1, 128)
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(9), 3)
+        qkv = tuple(
+            jax.random.normal(kx, (b, s, h, hd), jnp.bfloat16)
+            for kx in (kq, kk, kv)
+        )
+        dense_att = jax.jit(
             lambda q, k, v: att._finish(
-                pa.flash_attention_partials(q, k, v, causal=True)
+                pa.attend_partials_einsum(q, k, v, 0, 0, True)
             )
         )
+        announce(f'attn_einsum_s{s}')
+        t = timeit(dense_att, *qkv, iters=args.iters)
+        report(f'attn_einsum_s{s}', t)
+        if on_tpu and run_pallas:
+            flash = jax.jit(
+                lambda q, k, v: att._finish(
+                    pa.flash_attention_partials(q, k, v, causal=True)
+                )
+            )
 
-        def flash_check(t2, _t_einsum=t):
-            err = float(jnp.abs(
-                flash(*qkv).astype(jnp.float32)
-                - dense_att(*qkv).astype(jnp.float32)
-            ).max())
-            return {'max_err': round(err, 5),
-                    'speedup': round(_t_einsum / t2, 2)}
+            def flash_check(t2, _t_einsum=t):
+                err = float(jnp.abs(
+                    flash(*qkv).astype(jnp.float32)
+                    - dense_att(*qkv).astype(jnp.float32)
+                ).max())
+                return {'max_err': round(err, 5),
+                        'speedup': round(_t_einsum / t2, 2)}
 
-        measured(f'attn_flash_s{s}',
-                 lambda n: timeit(flash, *qkv, iters=n), args.iters,
-                 post=flash_check)
+            measured(f'attn_flash_s{s}',
+                     lambda n: timeit(flash, *qkv, iters=n), args.iters,
+                     post=flash_check)
 
     if not args.skip_factor_ops:
         for d in args.sizes:
@@ -438,8 +594,9 @@ def main():
             if xla_ops:
                 qiters = max(3, args.iters // 4)
                 f = jax.jit(lambda c: jnp.linalg.eigh(c))
-                measured(f'eigh_{d}', lambda n: timeit(f, cov, iters=n),
-                         qiters)
+                track('eigh', 3.0, d,
+                      measured(f'eigh_{d}',
+                               lambda n: timeit(f, cov, iters=n), qiters))
 
                 # host-offloaded eigh (pure_callback -> LAPACK): the EIGEN
                 # method's TPU escape hatch — measures the d^2 transfer +
@@ -451,8 +608,9 @@ def main():
                 fh = jax.jit(
                     lambda c: factors_lib.batched_eigh(c, impl='host')
                 )
-                measured(f'eigh_host_{d}',
-                         lambda n: timeit(fh, cov, iters=n), qiters)
+                track('eigh_host', 3.0, d,
+                      measured(f'eigh_host_{d}',
+                               lambda n: timeit(fh, cov, iters=n), qiters))
 
                 # cholesky factor + solve against identity (INVERSE method)
                 def chol_inv(c):
@@ -463,9 +621,12 @@ def main():
                         l, jnp.eye(d, dtype=c.dtype)
                     )
 
-                measured(f'cholesky_inv_{d}',
-                         lambda n: timeit(jax.jit(chol_inv), cov, iters=n),
-                         qiters)
+                track('cholesky_inv', 3.0, d,
+                      measured(f'cholesky_inv_{d}',
+                               lambda n: timeit(
+                                   jax.jit(chol_inv), cov, iters=n
+                               ),
+                               qiters))
 
                 # Newton-Schulz damped inverse: 2*iters MXU matmuls, the
                 # library's TPU default (default_compute_method)
@@ -478,9 +639,10 @@ def main():
                     ).max())
                     return {'residual_inf': round(err, 6)}
 
-                measured(f'newton_schulz25_{d}',
-                         lambda n: timeit(ns, cov, iters=n), qiters,
-                         post=ns_residual)
+                track('newton_schulz25', 3.0, d,
+                      measured(f'newton_schulz25_{d}',
+                               lambda n: timeit(ns, cov, iters=n), qiters,
+                               post=ns_residual))
 
                 # warm-started refresh at factor-EMA drift (the library
                 # passes the previous inverse as x0 at every
@@ -506,9 +668,10 @@ def main():
                         'cold_iters': int(cold.iterations),
                     }
 
-                measured(f'newton_schulz_warm_{d}',
-                         lambda n: timeit(warm, drift, iters=n), qiters,
-                         post=warm_iters)
+                track('newton_schulz_warm', 3.0, d,
+                      measured(f'newton_schulz_warm_{d}',
+                               lambda n: timeit(warm, drift, iters=n),
+                               qiters, post=warm_iters))
 
             # covariance: XLA dense contraction vs Pallas triangular kernel
             for dt, tag in ((jnp.float32, 'f32'), (jnp.bfloat16, 'bf16')):
@@ -522,6 +685,7 @@ def main():
                 announce(f'cov_dense_{d}_{tag}')
                 t = timeit(dense, md, iters=args.iters)
                 report(f'cov_dense_{d}_{tag}', t)
+                track(f'cov_dense_{tag}', 2.0, d, t)
                 if run_pallas:
                     from kfac_tpu.ops import pallas_cov
 
@@ -534,14 +698,17 @@ def main():
                         ).max())
                         return {'max_err': round(err, 5)}
 
-                    measured(
+                    track(f'cov_pallas_{tag}', 2.0, d, measured(
                         f'cov_pallas_{d}_{tag}',
                         lambda n, _md=md: timeit(
                             jax.jit(lambda a: pallas_cov.sym_cov(a)), _md,
                             iters=n,
                         ),
                         args.iters, post=cov_check,
-                    )
+                    ))
+
+    if sweeps:
+        report_floor_verdicts(sweeps)
 
     if args.resnet:
         bench_resnet50_inverse_update(args.iters)
