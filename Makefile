@@ -77,8 +77,8 @@ prof:
 # measurement-truth layer (prof: dispatch-free microbench, threshold
 # derivation, calibration), the unified static-analysis pass (which
 # includes the named-scope, metric-key, plan-schema, compression-knob,
-# fleet-knob and calibration-knob lints as
-# KFL101-KFL103/KFL105/KFL106/KFL108 plus the IR-tier smoke pass via
+# fleet-knob, calibration-knob and topology-knob lints as
+# KFL101-KFL103/KFL105/KFL106/KFL108/KFL109 plus the IR-tier smoke pass via
 # lint-ir), and the kfac_inspect analysis selftest
 # (see docs/OBSERVABILITY.md)
 obs: async lint compress fleet prof
@@ -100,7 +100,7 @@ lint-pod:
 	$(TEST_ENV) $(PY) tools/kfaclint.py --pod
 
 # kfaclint: AST rules (KFL001-KFL005) + docs-vs-code drift rules
-# (KFL100-KFL105) + IR rules (KFL201-KFL205, smoke profile) + pod rules
+# (KFL100-KFL109) + IR rules (KFL201-KFL205, smoke profile) + pod rules
 # (KFL301-KFL305) + the analyzer's own fixture selftest and test suites
 # (see docs/ANALYSIS.md). The --all pass runs under `timeout` as a
 # wall-clock budget assertion: every tier together must stay a

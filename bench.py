@@ -557,6 +557,45 @@ def _fleet_probe(steps: int = 6) -> dict:
         }
 
 
+def _pipeline_probe() -> dict:
+    """3D-planner pipeline-schedule probe (docs/AUTOTUNE.md "3D topology
+    planner").
+
+    Folds the committed measured-vs-predicted bubble table
+    (``kfac_tpu/planner/bubble_table.json``) into the round JSON: per
+    ``(schedule, p, v)`` the simulator's predicted bubble fraction, the
+    measured fraction, the p50 step wall-clock, and the floor-verdict
+    flag, under the one-dispatch harness provenance the measured tier
+    recorded (harness_version / dispatch_mode / dispatches). Read-only —
+    it loads the artifact rather than re-measuring, so a bench round
+    stays bounded while still publishing how far each schedule's
+    wall-clock sits from its simulated prediction.
+    """
+    from kfac_tpu.planner import execute
+
+    table = execute.load_bubble_table(execute.ARTIFACT_PATH)
+    if not table:
+        return {'status': 'missing'}
+    rows = [
+        {
+            'schedule': r['schedule'], 'p': r['p'], 'v': r['v'],
+            'predicted_fraction': round(r['predicted_fraction'], 4),
+            'measured_fraction': round(r['measured']['fraction'], 4),
+            'wall_clock_p50_s': r['measured']['wall_clock_p50_s'],
+            'contaminated': r['contaminated'],
+        }
+        for r in table['rows']
+    ]
+    return {
+        'status': 'ok',
+        'schema': table['schema'],
+        'tolerance': table['tolerance'],
+        'clean_rows': sum(not r['contaminated'] for r in rows),
+        'rows': rows,
+        'provenance': table.get('provenance', {}),
+    }
+
+
 def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     """Observability probe: per-step metrics JSONL, metrics-on overhead vs
     a metrics-off loop timed back-to-back, and a phase-level step-time
@@ -701,6 +740,11 @@ def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     _atomic_write(out_path, result)
     _log('  fleet probe (model-only retune + migration downtime)')
     result['fleet_probe'] = _fleet_probe()
+
+    # 3D-planner schedule table: measured-vs-predicted bubble fractions
+    _atomic_write(out_path, result)
+    _log('  pipeline probe (bubble table: measured vs simulated)')
+    result['pipeline_probe'] = _pipeline_probe()
 
 
 # ---------------------------------------------------------------------------
@@ -1238,6 +1282,9 @@ _HEADLINE_KEYS = (
     # compressed-wire + cold-factor-offload probe (docs/ARCHITECTURE.md
     # "Compression & offload")
     'compression_probe',
+    # 3D-planner bubble table: measured vs simulated schedule fractions
+    # under the one-dispatch harness provenance (docs/AUTOTUNE.md)
+    'pipeline_probe',
     # active tuned layout plan, when KFAC_TUNE_PLAN is set (docs/AUTOTUNE.md)
     'tuned_plan',
     # newest committed TPU evidence, replayed when the TPU probe fails

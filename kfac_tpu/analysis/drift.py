@@ -1,4 +1,4 @@
-"""KFL100–KFL108: the migrated docs-vs-code drift linters.
+"""KFL100–KFL109: the migrated docs-vs-code drift linters.
 
 These are ``kind='project'`` rules — unlike the AST rules they import
 the live ``kfac_tpu`` modules and compare real objects (metric schemas,
@@ -461,6 +461,40 @@ def _calibration_knobs() -> list[core.Finding]:
     return _doc_findings('KFL108', OBSERVABILITY_DOC, line, problems)
 
 
+# --------------------------------------------------- KFL109 topology knobs
+
+
+def check_topology_knobs(doc_path: str = AUTOTUNE_DOC) -> list[str]:
+    """Drift between the docs/AUTOTUNE.md "Topology knobs" table and the
+    ``TopologyConfig`` dataclass fields — the grid bounds of the 3D
+    DP×TP×PP planner."""
+    import dataclasses
+
+    section, _ = doc_section(doc_path, '### Topology knobs')
+    documented = table_first_cells(section)
+    from kfac_tpu.planner import topology as topology_lib
+
+    actual = {
+        f.name for f in dataclasses.fields(topology_lib.TopologyConfig)
+    }
+    problems = []
+    for k in sorted(actual - documented):
+        problems.append(f'undocumented config field (add to {doc_path}): {k}')
+    for k in sorted(documented - actual):
+        problems.append(
+            f'documented knob is not a TopologyConfig field: {k}')
+    return problems
+
+
+def _topology_knobs() -> list[core.Finding]:
+    try:
+        _, line = doc_section(AUTOTUNE_DOC, '### Topology knobs')
+        problems = check_topology_knobs()
+    except (OSError, ValueError) as exc:
+        return _doc_findings('KFL109', AUTOTUNE_DOC, 1, [str(exc)])
+    return _doc_findings('KFL109', AUTOTUNE_DOC, line, problems)
+
+
 # --------------------------------------------------------------- registration
 
 
@@ -571,5 +605,17 @@ core.register(core.Rule(
         'trigger; an undocumented (or phantom) knob means the drift '
         'threshold that re-layouts a live job is configured by folklore',
     check=_calibration_knobs,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL109',
+    name='topology-knobs-doc',
+    what='drift between the docs/AUTOTUNE.md "Topology knobs" table and '
+         'the planner TopologyConfig dataclass fields',
+    why='the 3D planner\'s grid bounds decide which DP×TP×PP meshes a '
+        'pod will even consider; an undocumented (or phantom) knob means '
+        'the mesh factorization of a training run is chosen by folklore',
+    check=_topology_knobs,
     kind='project',
 ))

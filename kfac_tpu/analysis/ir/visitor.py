@@ -149,6 +149,28 @@ def collective_axis_uses(jaxpr) -> list[tuple[str, str]]:
     return uses
 
 
+def ppermute_bytes(jaxpr, axis_name: str | None = None) -> int:
+    """Per-occurrence ``ppermute`` payload bytes in the traced program.
+
+    Each ``ppermute`` equation is counted ONCE (a ``lax.scan`` body is
+    symbolic — one equation per permute regardless of trip count), so for
+    the pipeline scans this is the per-TICK wire traffic of one rank;
+    multiply by the schedule's tick count for the per-step total. Pass
+    ``axis_name`` to restrict the count to one mesh axis (e.g. the
+    ``'pipe'`` ring).
+    """
+    total = 0
+    for eqn, _ in iter_eqns(jaxpr):
+        if eqn.primitive.name != 'ppermute':
+            continue
+        if axis_name is not None:
+            names = set(_flatten_axis_names(eqn.params.get('axis_name')))
+            if axis_name not in names:
+                continue
+        total += sum(aval_bytes(v.aval) for v in eqn.invars)
+    return total
+
+
 def mesh_axis_names(jaxpr) -> set[str]:
     """Axis names of every mesh mentioned by ``shard_map``/sharding eqns."""
     names: set[str] = set()

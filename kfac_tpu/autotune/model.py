@@ -99,6 +99,9 @@ class Candidate:
             'async_inverse': self.async_inverse,
             'stat_compression': self.stat_compression,
             'offload': self.offload,
+            # KAISA-grid candidates carry no mesh factorization; the 3D
+            # planner (kfac_tpu.planner) overrides this on its rows
+            'topology': None,
         }
 
 

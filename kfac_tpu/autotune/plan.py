@@ -44,6 +44,7 @@ KNOB_KEYS = (
     'async_inverse',
     'stat_compression',
     'offload',
+    'topology',
 )
 
 # Knobs added after schema-v1 plans shipped: absent in older documents,
@@ -52,6 +53,11 @@ OPTIONAL_KNOBS: dict[str, Any] = {
     'async_inverse': None,
     'stat_compression': None,
     'offload': False,
+    # PR-14 3D planner output: {dp, tp, pp, virtual_chunks, microbatches,
+    # schedule} or None for pure-KAISA plans. Mesh-side like strategy /
+    # grad_worker_fraction — resolve_auto_layout consumes it, apply_knobs
+    # leaves the config untouched.
+    'topology': None,
 }
 
 
@@ -252,6 +258,38 @@ def resolve_auto_layout(
             f'plan was tuned for a different {"/".join(diff) or "setup"}',
         )
         return config, mesh, False
+    topo = plan.knobs.get('topology')
+    if topo:
+        import jax
+
+        pp = int(topo.get('pp', 1))
+        tp = int(topo.get('tp', 1))
+        world = (
+            len(mesh.devices.reshape(-1)) if mesh is not None
+            else jax.device_count()
+        )
+        if pp < 1 or tp < 1 or world % (pp * tp) != 0:
+            # a topology plan that doesn't factor the live device count
+            # was tuned for a different pod — same failure class as a
+            # fingerprint mismatch, same non-fatal outcome
+            warnings_lib.warn_layout_event(
+                'fingerprint-mismatch',
+                f'plan topology pp={pp} tp={tp} does not divide the '
+                f'{world}-device world',
+            )
+            return config, mesh, False
+        if mesh is not None:
+            have_pp = dict(mesh.shape).get(mesh_lib.PIPE_AXIS, 1)
+            if have_pp != pp:
+                warnings_lib.warn_layout_event(
+                    'mesh-mismatch',
+                    f'given mesh has {have_pp} pipeline stages, plan '
+                    f'wants {pp}',
+                )
+                return config, mesh, False
+        else:
+            mesh = mesh_lib.pipeline_mesh(n_stages=pp, model=tp)
+        return apply_knobs(config, plan.knobs), mesh, True
     frac = float(plan.knobs['grad_worker_fraction'])
     if mesh is not None:
         world = mesh_lib.grad_workers(mesh) * mesh_lib.n_cols(mesh)

@@ -246,6 +246,7 @@ def autotune(
     inv_cadences: Sequence[int] | None = None,
     warmup: int = 1,
     iters: int = 5,
+    topology: bool | Any = False,
 ) -> plan_lib.TunedPlan:
     """Run the full search and return the :class:`TunedPlan`.
 
@@ -254,11 +255,25 @@ def autotune(
     otherwise the top-K candidates and the strategy baselines are timed
     and the measured median picks the winner (ties break by predicted
     cost, then enumeration order, keeping the artifact deterministic).
+
+    With ``topology`` truthy the KAISA grid is skipped entirely and the
+    3D DP×TP×PP planner (:func:`kfac_tpu.planner.plan_topology`) ranks
+    mesh factorizations instead; pass a
+    :class:`~kfac_tpu.planner.TopologyConfig` to bound the factor grid.
     """
     import jax
 
     if world is None:
         world = jax.device_count()
+    if topology:
+        from kfac_tpu import planner as planner_lib
+
+        kwargs = {}
+        if isinstance(topology, planner_lib.TopologyConfig):
+            kwargs['config'] = topology
+        return planner_lib.plan_topology(
+            base, world=world, hardware=hardware, **kwargs,
+        )
     cands = enumerate_candidates(
         world, base, fractions=fractions, granularities=granularities,
         transports=transports, inv_cadences=inv_cadences,

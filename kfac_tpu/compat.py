@@ -10,6 +10,14 @@ renames — ``axis_names`` (the manual axes) replaced the complementary
 ``auto`` frozenset, and ``check_vma`` replaced ``check_rep``. On installs
 without the top-level binding we install an adapter that accepts the new
 spelling and translates.
+
+``jax.lax.pcast``: the varying-manual-axes annotation that newer JAX
+requires inside ``shard_map`` bodies (replication is declared, not
+inferred). Legacy installs infer replication instead, so the annotation
+is semantically a no-op there — we install an identity and default the
+``shard_map`` adapter to ``check_rep=False``, because the legacy checker
+would otherwise reject out_specs whose varying-ness only the (absent)
+annotations could prove.
 """
 
 from __future__ import annotations
@@ -39,6 +47,8 @@ def _install_shard_map() -> None:
             )
         if check_vma is not None:
             kwargs['check_rep'] = check_vma
+        else:
+            kwargs.setdefault('check_rep', False)
         return _legacy(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
         )
@@ -46,4 +56,16 @@ def _install_shard_map() -> None:
     jax.shard_map = shard_map
 
 
+def _install_pcast() -> None:
+    if hasattr(jax.lax, 'pcast'):
+        return
+
+    def pcast(x: Any, axis_name: Any, *, to: str | None = None) -> Any:
+        del axis_name, to  # legacy shard_map infers replication
+        return x
+
+    jax.lax.pcast = pcast
+
+
 _install_shard_map()
+_install_pcast()

@@ -183,6 +183,10 @@ class InterleavedPipelinedLM(pipeline_lib.PipelinedLM):
                 {k: jnp.zeros_like(x) for k, x in gst.items()}
             ),
             n_b=zeros_like_vary(jnp.zeros((v,), jnp.float32)),
+            # per-rank (executed F, executed B, idle) tick counters —
+            # incremented from the live op kind each tick, so the counts
+            # come out of the executed scan, not the static tables
+            ticks=zeros_like_vary(jnp.zeros((3,), jnp.int32)),
         )
         zero_msg = zeros_like_vary(jnp.zeros((b_m, s_len, d), self.dtype))
         zero_meta = zeros_like_vary(jnp.zeros((3,), jnp.int32))
@@ -339,6 +343,9 @@ class InterleavedPipelinedLM(pipeline_lib.PipelinedLM):
             carry, s_act, am, s_cot, cm = jax.lax.switch(
                 kind + 1, [idle_branch, f_branch, b_branch], carry
             )
+            carry['ticks'] = carry['ticks'] + jnp.stack(
+                [kind == 0, kind == 1, kind < 0]
+            ).astype(jnp.int32)
 
             # uniform collectives: every rank permutes every tick (invalid
             # messages are zeros; the metadata valid flag gates the write)
@@ -390,12 +397,28 @@ class InterleavedPipelinedLM(pipeline_lib.PipelinedLM):
             }
             n_b = jax.lax.psum(n_b, self.data_axes)
         xbar = jax.lax.psum(carry['xbar'], PIPE_AXIS)
-        return loss_sum, sgrads, hgrads, lgrads, a_acc, g_acc, n_b, xbar
+        tick_counts = carry['ticks']
+        if self.data_axes:
+            # every dp replica of a pipe rank counted the same schedule;
+            # pmax collapses the data axes without inflating the counts
+            tick_counts = jax.lax.pmax(tick_counts, self.data_axes)
+        return (
+            loss_sum, sgrads, hgrads, lgrads, a_acc, g_acc, n_b, xbar,
+            tick_counts[None],
+        )
 
     # ------------------------------------------------------------- loss
 
     def loss_and_stats(self, params, batch):
         """(loss, grads, chunk-stacked stats) from the single-slot scan."""
+        loss, grads, stats, _ = self.loss_stats_and_ticks(params, batch)
+        return loss, grads, stats
+
+    def loss_stats_and_ticks(self, params, batch):
+        """:meth:`loss_and_stats` plus the per-rank ``(p, 3)`` int32
+        tick counters ``(executed F, executed B, idle)`` surfaced from
+        the scan carry — the runtime ground truth
+        :meth:`tick_report` diffs against the schedule tables."""
         tokens, targets = batch
         b, s = tokens.shape
         m = self.n_microbatches
@@ -433,10 +456,12 @@ class InterleavedPipelinedLM(pipeline_lib.PipelinedLM):
                 {k: P(PIPE_AXIS) for k in gstats0},
                 P(PIPE_AXIS),
                 bspec,
+                P(PIPE_AXIS),
             ),
         )(params['stages'], params['head'], params['ln_f'], x_feed, t_feed,
           gstats0)
-        loss, sgrads, hgrads, lgrads, a_stats, g_stats, counts, xbar = out
+        (loss, sgrads, hgrads, lgrads, a_stats, g_stats, counts, xbar,
+         tick_counts) = out
         (egrads,) = embed_pull(xbar)
         grads = {
             'embed': egrads['embed'],
@@ -448,4 +473,53 @@ class InterleavedPipelinedLM(pipeline_lib.PipelinedLM):
         denom = jnp.maximum(counts, 1.0)
         a_avg = {k: x / denom[:, None, None] for k, x in a_stats.items()}
         g_avg = {k: x / denom[:, None, None] for k, x in g_stats.items()}
-        return loss, grads, capture_lib.CapturedStats(a=a_avg, g=g_avg)
+        return (
+            loss, grads, capture_lib.CapturedStats(a=a_avg, g=g_avg),
+            tick_counts,
+        )
+
+    # ------------------------------------------------------------ report
+
+    def tick_report(self, tick_counts=None):
+        """``comms_report()``-style schedule accounting for this model.
+
+        The ``predicted`` block comes from the static schedule tables
+        (exact per-rank F/B/idle slot counts and the simulator's
+        :meth:`~kfac_tpu.parallel.interleaved.SingleSlotSchedule.bubble_slots`);
+        pass the counters returned by :meth:`loss_stats_and_ticks` as
+        ``tick_counts`` to fold in the EXECUTED counts and the
+        ``matches_schedule`` verdict.
+        """
+        import numpy as np
+
+        sched = self._sched
+        kinds = np.asarray(sched.ops)[:, :, 0]
+        predicted = np.stack(
+            [(kinds == 0).sum(0), (kinds == 1).sum(0), (kinds < 0).sum(0)],
+            axis=1,
+        )
+        p = self.p_ranks
+        out = {
+            'schedule': self.schedule,
+            'p_ranks': p,
+            'virtual_chunks': self.virtual_chunks,
+            'n_microbatches': self.n_microbatches,
+            'ticks': int(sched.ticks),
+            'bubble_slots': int(sched.bubble_slots()),
+            'bubble_fraction': float(sched.bubble_slots())
+            / float(sched.ticks * p),
+            'predicted': {
+                'executed_f': predicted[:, 0].tolist(),
+                'executed_b': predicted[:, 1].tolist(),
+                'idle': predicted[:, 2].tolist(),
+            },
+        }
+        if tick_counts is not None:
+            executed = np.asarray(tick_counts)
+            out['executed'] = {
+                'executed_f': executed[:, 0].tolist(),
+                'executed_b': executed[:, 1].tolist(),
+                'idle': executed[:, 2].tolist(),
+            }
+            out['matches_schedule'] = bool((executed == predicted).all())
+        return out

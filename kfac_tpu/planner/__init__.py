@@ -1,0 +1,46 @@
+"""3D DP×TP×PP topology planner (docs/AUTOTUNE.md "3D topology planner").
+
+Extends the autotune subsystem from a KAISA-knob grid to full mesh
+factorization: :mod:`~kfac_tpu.planner.topology` enumerates
+``(dp, tp, pp, v, microbatches)`` factorizations of the device count,
+derives each candidate's bubble fraction by executing the interleaved
+schedule simulator, and prices stage-local MEM-OPT factor placement,
+per-tick ``ppermute`` bytes and per-stage HBM on top of the existing
+``StaticLayout``/``predict()`` cost terms;
+:mod:`~kfac_tpu.planner.execute` is the measured tier behind the
+committed ``bubble_table.json`` artifact.
+"""
+
+from kfac_tpu.planner.execute import (
+    ARTIFACT_PATH,
+    invalidate_cache,
+    load_bubble_table,
+    measure_row,
+    measured_bubble_correction,
+)
+from kfac_tpu.planner.topology import (
+    TopologyCandidate,
+    TopologyConfig,
+    bubble_fraction,
+    enumerate_topologies,
+    pipeline_ppermute_bytes_per_tick,
+    plan_topology,
+    predict_topology,
+    schedule_terms,
+)
+
+__all__ = [
+    'ARTIFACT_PATH',
+    'TopologyCandidate',
+    'TopologyConfig',
+    'bubble_fraction',
+    'enumerate_topologies',
+    'invalidate_cache',
+    'load_bubble_table',
+    'measure_row',
+    'measured_bubble_correction',
+    'pipeline_ppermute_bytes_per_tick',
+    'plan_topology',
+    'predict_topology',
+    'schedule_terms',
+]

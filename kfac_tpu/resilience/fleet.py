@@ -307,6 +307,16 @@ class FleetController:
                 'running the cost-model-only retune',
             )
             plan = self._retune('topology-changed')
+        elif plan is not None and not self._topology_fits(plan):
+            topo = plan.knobs.get('topology') or {}
+            warnings_lib.warn_fleet_event(
+                'topology-changed',
+                f"plan pipeline factorization pp={topo.get('pp')} "
+                f"tp={topo.get('tp')} does not divide the "
+                f'{jax.device_count()}-device world; running the '
+                'cost-model-only retune',
+            )
+            plan = self._retune('topology-changed')
         elif plan is None:
             plan = self._retune('startup')
         engine, applied = self._build_engine(plan)
@@ -359,6 +369,19 @@ class FleetController:
         self._event('retune', detail=reason)
         return plan
 
+    @staticmethod
+    def _topology_fits(plan: plan_lib.TunedPlan) -> bool:
+        """A 3D-planner plan fits only when its ``pp * tp`` factors the
+        live device count — an elastic shrink/grow can break that even
+        when the coarse fingerprint still matches (same backend, same
+        device kind, restored before the count is re-fingerprinted)."""
+        topo = (plan.knobs or {}).get('topology')
+        if not topo:
+            return True
+        pp = int(topo.get('pp', 1))
+        tp = int(topo.get('tp', 1))
+        return pp >= 1 and tp >= 1 and jax.device_count() % (pp * tp) == 0
+
     def _build_engine(
         self, plan: plan_lib.TunedPlan | None
     ) -> tuple[Any, bool]:
@@ -367,6 +390,16 @@ class FleetController:
         from kfac_tpu.parallel.kaisa import DistributedKFAC
 
         if plan is None:
+            return DistributedKFAC(config=self.base), False
+        if (plan.knobs or {}).get('topology'):
+            # topology plans drive pipeline engines (PipelinedLM /
+            # PipelineKFAC own the pipe mesh); the flat KAISA engine
+            # cannot honor them, so the fleet runs canonically
+            warnings_lib.warn_fleet_event(
+                'plan-not-applied',
+                'plan carries a 3D topology; the fleet drives the flat '
+                'KAISA engine, rebuilding under the canonical layout',
+            )
             return DistributedKFAC(config=self.base), False
         engine = DistributedKFAC(config=self.base, auto_layout=plan)
         if not engine.auto_layout_applied:

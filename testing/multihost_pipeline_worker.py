@@ -1,0 +1,122 @@
+"""Worker process for the 2-process pipeline-parallel multihost smoke.
+
+Launched by tests/parallel/test_multihost.py with the same
+KFAC_TPU_COORDINATOR / KFAC_TPU_NUM_PROCESSES / KFAC_TPU_PROCESS_ID
+rendezvous surface as multihost_worker.py, ONE virtual device per
+process: the 2-stage pipeline mesh spans the OS-process boundary, so
+every per-tick ``ppermute`` of the interleaved scan crosses the
+coordination-service transport instead of staying inside one process —
+the path the in-process 8-device tests cannot exercise.
+
+Each rank runs the single-slot interleaved scan (p=2, v=2, m=4) on a
+fixed-PRNG tiny LM, reports the replicated loss, a checksum of the
+replicated (embed/head/ln_f) gradients, and its OWN executed
+(F, B, idle) tick-counter row from the scan carry. The test pins the
+loss against the same scan computed in a single process and the tick
+rows against the schedule tables.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
+
+from kfac_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from kfac_tpu.parallel import interleaved_scan, mesh as mesh_lib  # noqa: E402
+
+GEOM = dict(
+    vocab_size=64, d_model=32, num_heads=4, num_layers=4,
+    n_microbatches=4, max_len=16,
+)
+
+
+def global_put(arr, sharding):
+    """Host array -> global jax.Array across processes (every process
+    passes the same full array; each contributes its addressable shards).
+    Arrays that already span the world (model.init device_puts the stage
+    stack over the pipe axis itself) pass through untouched."""
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        return arr
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
+def main() -> None:
+    expected = int(os.environ['KFAC_TPU_NUM_PROCESSES'])
+    assert jax.process_count() == expected, jax.process_count()
+    assert len(jax.devices()) == expected, jax.devices()
+
+    mesh = mesh_lib.pipeline_mesh(n_stages=2, devices=jax.devices())
+    model = interleaved_scan.InterleavedPipelinedLM(
+        mesh=mesh, virtual_chunks=2, **GEOM
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    m, s = GEOM['n_microbatches'], GEOM['max_len']
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (m, s), 0, GEOM['vocab_size']
+    )
+    targets = jax.random.randint(
+        jax.random.PRNGKey(2), (m, s), 0, GEOM['vocab_size']
+    )
+
+    rep = NamedSharding(mesh, P())
+    stage_sh = NamedSharding(mesh, P(mesh_lib.PIPE_AXIS))
+    params = {
+        key: jax.tree_util.tree_map(
+            lambda x: global_put(
+                x, stage_sh if key == 'stages' else rep  # noqa: B023
+            ),
+            params[key],
+        )
+        for key in params
+    }
+    batch = (global_put(tokens, rep), global_put(targets, rep))
+
+    loss, grads, _, ticks = jax.jit(model.loss_stats_and_ticks)(
+        params, batch
+    )
+    jax.block_until_ready(loss)
+    # embed/head/ln_f gradients come out replicated (psum over the pipe
+    # axis), so every process can checksum them locally; stage grads are
+    # pipe-sharded and stay out of the cross-rank comparison
+    checksum = float(
+        sum(
+            jnp.sum(jnp.abs(leaf.astype(jnp.float32)))
+            for key in ('embed', 'pos_embed', 'head', 'ln_f')
+            for leaf in jax.tree_util.tree_leaves(grads[key])
+        )
+    )
+    # this process's executed (F, B, idle) tick-counter row
+    local_ticks = np.asarray(ticks.addressable_data(0)).reshape(3)
+    print(
+        json.dumps(
+            {
+                'process': jax.process_index(),
+                'n_processes': jax.process_count(),
+                'loss': float(loss),
+                'checksum': checksum,
+                'ticks': [int(t) for t in local_ticks],
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,50 @@
+"""Shared compiled-pipeline fixtures for tests/parallel/.
+
+The pipeline scans are the most expensive compiles in the suite (a
+shard_map'd combined forward/backward scan per schedule); the
+module-scope fixtures here run ``loss_stats_and_ticks`` ONCE per test
+module and hand every consumer the same outputs, so adding a new
+assertion over the executed schedule costs zero extra compiles.
+"""
+
+import jax
+import pytest
+
+TICK_GEOM = dict(
+    vocab_size=64, d_model=32, num_heads=4, n_microbatches=4, max_len=16,
+)
+
+
+def _ilv_run(p: int, v: int):
+    """(model, loss, grads, stats, tick_counts) for one interleaved
+    point — m = n_microbatches rows of one sample each, dp = 1."""
+    from kfac_tpu.parallel import interleaved_scan
+    from kfac_tpu.parallel.mesh import pipeline_mesh
+
+    mesh = pipeline_mesh(n_stages=p, devices=jax.devices()[:p])
+    model = interleaved_scan.InterleavedPipelinedLM(
+        mesh=mesh, virtual_chunks=v, num_layers=p * v, **TICK_GEOM
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    m, s = TICK_GEOM['n_microbatches'], TICK_GEOM['max_len']
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (m, s), 0, TICK_GEOM['vocab_size']
+    )
+    targets = jax.random.randint(
+        jax.random.PRNGKey(2), (m, s), 0, TICK_GEOM['vocab_size']
+    )
+    out = jax.jit(model.loss_stats_and_ticks)(params, (tokens, targets))
+    return (model,) + tuple(out)
+
+
+@pytest.fixture(scope='module')
+def ilv_ticks_p2v2():
+    """Compiled interleaved p=2 v=2 m=4 run, shared across the module."""
+    return _ilv_run(2, 2)
+
+
+@pytest.fixture(scope='module')
+def ilv_ticks_p4v2():
+    """Compiled interleaved p=4 v=2 m=4 run (the heaviest schedule the
+    fast tier touches lives behind this one compile)."""
+    return _ilv_run(4, 2)

@@ -184,6 +184,50 @@ def test_interleaved_field_roundtrip_and_apply_guard():
         plm.apply(plm.init(jax.random.PRNGKey(0)), jnp.zeros((8, 8), jnp.int32))
 
 
+def test_tick_counters_match_schedule_table(ilv_ticks_p2v2):
+    """The executed (F, B, idle) counters surfaced from the scan carry
+    equal the static schedule table's per-rank slot counts exactly."""
+    model, _, _, _, ticks = ilv_ticks_p2v2
+    report = model.tick_report(np.asarray(ticks))
+    assert report['matches_schedule'], report
+    assert report['executed'] == report['predicted']
+
+
+def test_tick_idle_equals_simulator_bubble_slots(ilv_ticks_p2v2):
+    """Total executed idle slots == the simulator's ``bubble_slots()``
+    == the planner's ``schedule_terms`` accounting — the runtime ground
+    truth the 3D topology planner prices candidates with."""
+    from kfac_tpu.planner import topology
+
+    model, _, _, _, ticks = ilv_ticks_p2v2
+    counts = np.asarray(ticks)
+    p, v, m = 2, 2, 4
+    idle_total = int(counts[:, 2].sum())
+    assert idle_total == int(model._sched.bubble_slots())
+    terms = topology.schedule_terms('interleaved', p, v, m)
+    assert terms['source'] == 'simulator'
+    assert idle_total == terms['bubble_slots']
+    # every rank counts each tick exactly once (F, B, or idle)
+    assert int(counts.sum()) == terms['ticks'] * p
+    assert counts[:, :2].sum(axis=1).tolist() == [2 * m * v] * p
+
+
+@pytest.mark.slow
+def test_tick_counters_p4(ilv_ticks_p4v2):
+    """Same executed-vs-simulator identity on the deepest pipe the
+    suite's 8 virtual devices admit (p=4, v=2: 8 logical stages)."""
+    from kfac_tpu.planner import topology
+
+    model, _, _, _, ticks = ilv_ticks_p4v2
+    counts = np.asarray(ticks)
+    report = model.tick_report(counts)
+    assert report['matches_schedule'], report
+    terms = topology.schedule_terms('interleaved', 4, 2, 4)
+    assert terms['source'] == 'simulator'
+    assert int(counts[:, 2].sum()) == terms['bubble_slots']
+    assert int(counts.sum()) == terms['ticks'] * 4
+
+
 def test_logical_to_stack_is_a_permutation():
     for p, v in ((2, 2), (4, 2), (2, 4), (4, 4)):
         idx = [logical_to_stack(p, v, s) for s in range(p * v)]

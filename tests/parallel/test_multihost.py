@@ -23,6 +23,9 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 WORKER = os.path.join(REPO, 'testing', 'multihost_worker.py')
 VOTE_WORKER = os.path.join(REPO, 'testing', 'multihost_vote_worker.py')
+PIPELINE_WORKER = os.path.join(
+    REPO, 'testing', 'multihost_pipeline_worker.py'
+)
 
 
 def _free_port() -> int:
@@ -211,6 +214,75 @@ def test_eight_process_protocol_smoke():
         assert r['mesh_shape'] == [4, 2]
         assert r['mesh_axes'] == ['kfac_gw', 'kfac_col']
         assert r['col0_hosts'] == [0, 1, 2, 3]
+
+
+@pytest.mark.slow
+def test_two_process_pipeline_matches_single_process():
+    """2 OS processes x 1 virtual device run the interleaved pipeline
+    scan (p=2, v=2, m=4) over a pipeline mesh that SPANS the process
+    boundary — every per-tick ppermute crosses the coordination-service
+    transport. The replicated loss and embed/head/ln_f gradient checksum
+    agree across ranks and match the same scan computed in one process,
+    and each rank's executed (F, B, idle) tick-counter row equals the
+    static schedule table's per-rank prediction."""
+    port = _free_port()
+    procs = _launch_workers(
+        2, port, worker=PIPELINE_WORKER, devices_per_proc=1
+    )
+    results = _collect_results(procs)
+
+    assert sorted(r['process'] for r in results) == [0, 1]
+    for r in results[1:]:
+        assert r['loss'] == results[0]['loss']
+        assert r['checksum'] == results[0]['checksum']
+
+    # single-process reference over 2 of the suite's virtual devices,
+    # identical geometry and PRNG streams (multihost_pipeline_worker.GEOM)
+    import jax.numpy as jnp
+
+    from kfac_tpu.parallel import interleaved_scan
+    from kfac_tpu.parallel.mesh import pipeline_mesh
+    from testing import multihost_pipeline_worker as worker_mod
+
+    geom = worker_mod.GEOM
+    mesh = pipeline_mesh(n_stages=2, devices=jax.devices()[:2])
+    model = interleaved_scan.InterleavedPipelinedLM(
+        mesh=mesh, virtual_chunks=2, **geom
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    m, s = geom['n_microbatches'], geom['max_len']
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (m, s), 0, geom['vocab_size']
+    )
+    targets = jax.random.randint(
+        jax.random.PRNGKey(2), (m, s), 0, geom['vocab_size']
+    )
+    loss, grads, _, ticks = jax.jit(model.loss_stats_and_ticks)(
+        params, (tokens, targets)
+    )
+    checksum = float(
+        sum(
+            jnp.sum(jnp.abs(leaf.astype(jnp.float32)))
+            for key in ('embed', 'pos_embed', 'head', 'ln_f')
+            for leaf in jax.tree_util.tree_leaves(grads[key])
+        )
+    )
+    np.testing.assert_allclose(results[0]['loss'], float(loss), rtol=1e-5)
+    np.testing.assert_allclose(results[0]['checksum'], checksum, rtol=1e-4)
+
+    # executed counters, per rank, against the schedule table — the
+    # cross-process run must execute the exact same slot sequence the
+    # simulator prices
+    report = model.tick_report(np.asarray(ticks))
+    assert report['matches_schedule'], report
+    predicted = report['predicted']
+    by_rank = {r['process']: r['ticks'] for r in results}
+    for rank in (0, 1):
+        assert by_rank[rank] == [
+            predicted['executed_f'][rank],
+            predicted['executed_b'][rank],
+            predicted['idle'][rank],
+        ], (rank, by_rank[rank], predicted)
 
 
 @pytest.mark.slow

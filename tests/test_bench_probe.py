@@ -293,3 +293,23 @@ def test_async_spike_probe_flattens_refresh_spike():
     assert out['refresh_spike_ratio_sync'] > out['refresh_spike_ratio'], out
     for k in ('step_p50_ms', 'step_p95_ms', 'step_max_ms'):
         assert out[k] > 0 and out[f'{k}_sync'] > 0
+
+
+def test_pipeline_probe_folds_committed_bubble_table():
+    """The pipeline probe republishes the committed measured-vs-simulated
+    schedule table with its one-dispatch harness provenance, read-only."""
+    out = bench._pipeline_probe()
+    assert out['status'] == 'ok'
+    assert out['clean_rows'] >= len(out['rows']) // 2
+    covered = {(r['schedule'], r['p'], r['v']) for r in out['rows']}
+    assert {('1f1b', 2, 1), ('interleaved', 4, 2)} <= covered
+    for r in out['rows']:
+        assert 0.0 <= r['predicted_fraction'] < 1.0
+        assert r['wall_clock_p50_s'] > 0.0
+        if not r['contaminated']:
+            assert abs(
+                r['measured_fraction'] - r['predicted_fraction']
+            ) <= out['tolerance']
+    harness = out['provenance']['harness']
+    assert harness['harness_version'] == 2
+    assert harness['dispatches'] == 1

@@ -77,9 +77,21 @@ def _csv_ints(s: str) -> tuple[int, ...]:
     return tuple(int(v) for v in s.split(',') if v.strip())
 
 
+def _topo_col(knobs: dict[str, Any]) -> str:
+    topo = knobs.get('topology')
+    if not topo:
+        return ''
+    return (
+        f"dp{topo['dp']}.tp{topo['tp']}.pp{topo['pp']} "
+        f"v={topo['virtual_chunks']} m={topo['microbatches']} "
+        f"{topo['schedule']:<11} "
+    )
+
+
 def summarize(plan: Any) -> str:
     lines = [
         f'TunedPlan (schema {plan.schema}): winner '
+        f'{_topo_col(plan.knobs)}'
         f'{plan.knobs["strategy"]} frac={plan.knobs["grad_worker_fraction"]} '
         f'granularity={plan.knobs["bucket_granularity"]} '
         f'transport={plan.knobs["allreduce_method"]} '
@@ -94,7 +106,8 @@ def summarize(plan: Any) -> str:
         )
         feas = '' if row['feasible'] else '  INFEASIBLE'
         lines.append(
-            f'  {k["strategy"]:>10} frac={k["grad_worker_fraction"]:<7.4g} '
+            f'  {_topo_col(k)}'
+            f'{k["strategy"]:>10} frac={k["grad_worker_fraction"]:<7.4g} '
             f'gran={k["bucket_granularity"]:<4} '
             f'{k["allreduce_method"]:<19} '
             f'pred {row["predicted_step_s"]*1e6:9.2f} us  '
@@ -112,6 +125,21 @@ def run_search(args: argparse.Namespace) -> int:
     hardware = autotune.HardwareSpec(
         hbm_bytes=None if args.hbm_gb is None else args.hbm_gb * 2**30
     )
+    if args.topology:
+        # the 3D planner is predict-only: bubble fractions come from the
+        # executed-schedule simulators + the committed measured table
+        plan = autotune.autotune(
+            base, measure=False, hardware=hardware, topology=True,
+        )
+        if args.json:
+            json.dump(plan.to_json(), sys.stdout, indent=2, sort_keys=True)
+            print()
+        else:
+            print(summarize(plan))
+        if args.out:
+            plan.save(args.out)
+            print(f'wrote {args.out}')
+        return 0
     plan = autotune.autotune(
         base,
         None if args.no_measure else loss_fn,
@@ -197,6 +225,42 @@ def selftest() -> int:
     assert not eng.auto_layout_applied
     assert any(isinstance(r.message, LayoutPlanWarning) for r in rec)
 
+    # 3D topology planner: a pp>1 plan that round-trips byte-identically
+    # through save/load and resolves to a pipeline mesh
+    from kfac_tpu.autotune import plan as plan_mod
+    from kfac_tpu.parallel.mesh import PIPE_AXIS
+
+    topo_plan = autotune.autotune(base, measure=False, topology=True)
+    topo = topo_plan.knobs['topology']
+    assert topo and topo['pp'] > 1, f'planner picked a flat mesh: {topo}'
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, 'topo_plan.json')
+        topo_plan.save(path)
+        with open(path) as f:
+            raw1 = f.read()
+        loaded = kfac_tpu.TunedPlan.load(path)
+        assert loaded.to_json() == topo_plan.to_json(), 'topology round trip'
+        loaded.save(path)
+        with open(path) as f:
+            raw2 = f.read()
+        assert raw1 == raw2, 'topology plan save is not byte-stable'
+        cfg2, mesh2, applied = plan_mod.resolve_auto_layout(
+            base, None, loaded
+        )
+        assert applied, 'topology plan did not apply'
+        assert dict(mesh2.shape)[PIPE_AXIS] == topo['pp']
+
+    # a pre-planner plan document (no topology knob) still loads and
+    # defaults to the flat layout
+    legacy_doc = plan.to_json()
+    legacy_doc['knobs'] = {
+        k: val for k, val in legacy_doc['knobs'].items() if k != 'topology'
+    }
+    legacy = kfac_tpu.TunedPlan.from_json(legacy_doc)
+    assert legacy.knobs['topology'] is None
+    eng = DistributedKFAC(config=base, auto_layout=legacy)
+    assert eng.auto_layout_applied, 'pre-planner plan no longer applies'
+
     print('kfac_tune selftest ok')
     return 0
 
@@ -231,6 +295,9 @@ def main(argv: list[str] | None = None) -> int:
                              '(default: keep the base cadence)')
     search.add_argument('--hbm-gb', type=float, default=None,
                         help='per-device HBM budget for feasibility pruning')
+    search.add_argument('--topology', action='store_true',
+                        help='rank DP×TP×PP mesh factorizations with the '
+                             '3D planner instead of the flat KAISA grid')
     args = parser.parse_args(argv)
 
     _pin_host_platform()
