@@ -61,6 +61,7 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -596,6 +597,118 @@ def _pipeline_probe() -> dict:
     }
 
 
+def _fused_kernel_probe(d: int = 256, rows: int = 512) -> dict:
+    """Within-run A/B of the fused step-path kernels vs their unfused
+    XLA expressions (docs/ARCHITECTURE.md "Fused step-path kernels").
+
+    Per family (cov_ema / ns / klclip): p50 wall-clock of each variant,
+    timed back-to-back in THIS process so the comparison shares one
+    host-load regime, plus per-variant device milliseconds attributed
+    from a short profiler trace when the backend has device lanes
+    (empty off-TPU — the host p50s stand alone). Off-TPU the fused
+    variants run in interpret mode, so their numbers measure the
+    emulation, not Mosaic; the ``interpret`` flag says which regime the
+    record is from.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kfac_tpu.ops import pallas_cov_ema, pallas_ns
+
+    interp = pallas_ns.interpret_mode()
+    a = jax.random.normal(jax.random.PRNGKey(7), (rows, d), jnp.float32)
+    eye = jnp.eye(d, dtype=jnp.float32)
+    cov = a.T @ a / rows + 0.003 * eye
+    x0 = eye / jnp.trace(cov)
+    mx0 = cov @ x0
+    gmat = 0.5 * cov + 0.1 * eye
+    beta, coeff = 0.95, 0.05 / rows
+
+    def ema_unfused(f, x):
+        acc = jax.lax.dot_general(
+            x, x, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return beta * f + coeff * acc
+
+    def ns_unfused(mm, x, mx):
+        y = x @ (2.0 * eye - mx)
+        my = mm @ y
+        return y, my, jnp.linalg.norm(eye - my) / jnp.sqrt(float(d))
+
+    def kl_unfused(p, g):
+        return p * jnp.sum(p * g)
+
+    def kl_fused(p, g):
+        s = pallas_ns.fused_klclip_dot(p, g, interpret=interp)
+        return pallas_ns.fused_klclip_scale(p, s, interpret=interp)
+
+    pairs = {
+        'cov_ema': (ema_unfused,
+                    lambda f, x: pallas_cov_ema._fused(
+                        f, x, beta, coeff, interpret=interp),
+                    (eye, a)),
+        'ns': (ns_unfused,
+               lambda mm, x, mx: pallas_ns.fused_ns_step(
+                   mm, x, mx, interpret=interp),
+               (cov, x0, mx0)),
+        'klclip': (kl_unfused, kl_fused, (cov, gmat)),
+    }
+
+    def p50_ms(fn, args, n=9):
+        jax.block_until_ready(fn(*args))  # compile outside the clock
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return round(ts[len(ts) // 2] * 1e3, 3)
+
+    out: dict = {'config': f'd{d}_rows{rows}', 'interpret': interp}
+    jitted: dict = {}
+    for fam, (unfused, fused, args) in pairs.items():
+        scopes = {}
+        for variant, fn in (('unfused', unfused), ('fused', fused)):
+            name = f'fused_probe.{fam}_{variant}'
+            scopes[variant] = name
+            jitted[name] = (
+                jax.jit(lambda *xs, _f=fn, _n=name: (
+                    jax.named_scope(_n)(_f)(*xs)
+                )),
+                args,
+            )
+        row = {'unfused_p50_ms': p50_ms(*jitted[scopes['unfused']])}
+        try:
+            row['fused_p50_ms'] = p50_ms(*jitted[scopes['fused']])
+            row['speedup'] = round(
+                row['unfused_p50_ms'] / max(row['fused_p50_ms'], 1e-9), 3
+            )
+        except Exception as exc:  # one variant's failure costs one row
+            row['fused_error'] = f'{type(exc).__name__}: {exc}'
+        out[fam] = row
+
+    # device-truth attribution: trace one pass over every variant and
+    # attribute device lanes per probe scope (empty off-TPU)
+    try:
+        from kfac_tpu.observability import profiler, trace_attrib
+
+        tdir = tempfile.mkdtemp(prefix='fused_probe_trace_')
+        order = list(jitted)
+
+        def _traced(i):
+            fn, args = jitted[order[i % len(order)]]
+            return fn(*args)
+
+        profiler.capture_steps(tdir, _traced, steps=len(order))
+        device = trace_attrib.device_breakdown_ms(tdir, scopes=order)
+        if device:
+            out['device_ms'] = device
+    except Exception as exc:
+        out['trace_error'] = f'{type(exc).__name__}: {exc}'
+    return out
+
+
 def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     """Observability probe: per-step metrics JSONL, metrics-on overhead vs
     a metrics-off loop timed back-to-back, and a phase-level step-time
@@ -745,6 +858,11 @@ def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     _atomic_write(out_path, result)
     _log('  pipeline probe (bubble table: measured vs simulated)')
     result['pipeline_probe'] = _pipeline_probe()
+
+    # fused step-path kernel A/B: fused vs unfused, same process
+    _atomic_write(out_path, result)
+    _log('  fused kernel probe (cov+EMA / NS / kl-clip, fused vs unfused)')
+    result['fused_kernel_probe'] = _fused_kernel_probe()
 
 
 # ---------------------------------------------------------------------------
@@ -1285,6 +1403,10 @@ _HEADLINE_KEYS = (
     # 3D-planner bubble table: measured vs simulated schedule fractions
     # under the one-dispatch harness provenance (docs/AUTOTUNE.md)
     'pipeline_probe',
+    # fused step-path kernel A/B: per-family fused-vs-unfused p50 + the
+    # traced device attribution (docs/ARCHITECTURE.md "Fused step-path
+    # kernels")
+    'fused_kernel_probe',
     # active tuned layout plan, when KFAC_TUNE_PLAN is set (docs/AUTOTUNE.md)
     'tuned_plan',
     # newest committed TPU evidence, replayed when the TPU probe fails

@@ -241,6 +241,44 @@ def check_cost_model_parity(suite: harness.Suite) -> list[core.Finding]:
     return findings
 
 
+# ------------------------------------------------------------------ KFL206
+
+#: kernel function names allowed to appear as ``pallas_call`` eqns in
+#: traced engine programs — the registry the fused step-path kernels pin
+#: themselves to (kfac_tpu/ops/pallas_{cov,cov_ema,ns,attention}.py).
+#: An unlisted kernel on the step path is either a new kernel that
+#: skipped its pricing/equivalence/dispatch wiring, or a renamed one
+#: whose autotune price and docs now point at nothing.
+STEP_PALLAS_ALLOWLIST = frozenset({
+    '_sym_cov_kernel',
+    '_sym_cov_ema_kernel',
+    '_ns_xupdate_kernel',
+    '_ns_mx_resid_kernel',
+    '_klclip_dot_kernel',
+    '_klclip_scale_kernel',
+    '_flash_kernel',
+})
+
+
+def check_pallas_allowlist(suite: harness.Suite) -> list[core.Finding]:
+    """Every pallas_call kernel in a traced engine program must be on
+    :data:`STEP_PALLAS_ALLOWLIST`."""
+    findings: list[core.Finding] = []
+    for t in suite.traces:
+        for summary in visitor.pallas_call_summaries(t.jaxpr):
+            name = summary['name']
+            if name not in STEP_PALLAS_ALLOWLIST:
+                findings.append(_finding(
+                    t, 'KFL206',
+                    f'pallas_call kernel {name!r} (grid '
+                    f'{summary["grid"]}) is not on the step-path kernel '
+                    'allowlist; register it in '
+                    'analysis/ir/rules.STEP_PALLAS_ALLOWLIST alongside '
+                    'its autotune price and dispatch-table family',
+                ))
+    return findings
+
+
 # -------------------------------------------------------------- registration
 
 
@@ -294,4 +332,14 @@ core.register(core.Rule(
     why='the layout autotuner is only as good as its pricing; IR parity '
         'turns the cost model from tested-by-convention into verified',
     check=_bind(check_cost_model_parity), kind='ir',
+))
+
+core.register(core.Rule(
+    code='KFL206', name='ir-pallas-kernel-allowlist',
+    what='pallas_call eqns in traced engine programs whose kernel name '
+         'is not on the registered step-path allowlist',
+    why='a fused kernel that bypasses the allowlist also bypassed its '
+        'autotune price, equivalence test, and dispatch-table gate — '
+        'the contract that keeps hand-written Mosaic honest',
+    check=_bind(check_pallas_allowlist), kind='ir',
 ))

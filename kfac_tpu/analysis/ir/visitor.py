@@ -358,6 +358,40 @@ def while_dot_flops(jaxpr, iters: int) -> float:
     return total * iters
 
 
+def pallas_call_summaries(jaxpr) -> list[dict[str, Any]]:
+    """One summary dict per ``pallas_call`` eqn in the program.
+
+    ``name`` is the kernel function's name (``name_and_src_info`` —
+    stable under ``functools.partial`` binding of trace-time constants),
+    ``grid`` the launch grid, and ``dot_flops_per_tile`` the summed
+    ``dot_general`` FLOPs of ONE kernel-body invocation. The caller owns
+    the grid arithmetic: total MXU FLOPs = Σ over executing grid points
+    of the per-tile count (for the triangular cov kernels that is the
+    upper-triangle subset, not the full grid product — see the KFL205
+    fused parity test).
+    """
+    out: list[dict[str, Any]] = []
+    for eqn, _ in iter_eqns(jaxpr):
+        if eqn.primitive.name != 'pallas_call':
+            continue
+        info = eqn.params.get('name_and_src_info')
+        grid_mapping = eqn.params.get('grid_mapping')
+        inner = eqn.params.get('jaxpr')
+        dot = 0.0
+        if inner is not None:
+            dot = sum(
+                _dot_flops(sub)
+                for sub, _ in iter_eqns(inner)
+                if sub.primitive.name == 'dot_general'
+            )
+        out.append({
+            'name': getattr(info, 'name', None),
+            'grid': tuple(getattr(grid_mapping, 'grid', ()) or ()),
+            'dot_flops_per_tile': dot,
+        })
+    return out
+
+
 # --------------------------------------------------------------- callbacks
 
 

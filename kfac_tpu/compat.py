@@ -18,13 +18,47 @@ is semantically a no-op there — we install an identity and default the
 ``shard_map`` adapter to ``check_rep=False``, because the legacy checker
 would otherwise reject out_specs whose varying-ness only the (absent)
 annotations could prove.
+
+``jax.typeof``: newer JAX's aval accessor (the dispatch heuristics read
+``typeof(x).vma`` to thread manual-axes varying-ness into Pallas
+out_shapes). Legacy installs alias it to ``core.get_aval``; legacy avals
+carry no ``vma`` attribute, which downstream ``getattr(..., 'vma',
+None)`` reads treat as "no annotation" — correct, because legacy
+``shard_map`` infers replication instead of declaring it.
+
+``jax.sharding.get_abstract_mesh``: the trace-context mesh probe that
+``pallas_gate.manual_context`` uses to decide whether a raw
+``pallas_call`` may run (fully-manual context) or dispatch must fall
+back to XLA (partial-manual). Legacy installs never materialize an
+abstract mesh during ``shard_map`` body tracing and the ``auto`` set is
+dropped after staging, so the adapter records (mesh, manual axes) on a
+thread-local stack around the body itself and the installed
+``get_abstract_mesh`` answers from that stack with a duck-typed mesh
+whose ``axis_types`` uses the new-style name→type mapping.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 import jax
+
+
+class _CompatAbstractMesh:
+    """Duck-typed stand-in for the new-style abstract mesh: ``axis_names``
+    plus the name→type ``axis_types`` mapping ``manual_context`` reads."""
+
+    def __init__(self, axis_names: tuple, manual: frozenset):
+        self.axis_names = tuple(axis_names)
+        self.axis_types = {
+            name: 'Manual' if name in manual else 'Auto'
+            for name in self.axis_names
+        }
+
+
+_EMPTY_ABSTRACT_MESH = _CompatAbstractMesh((), frozenset())
+_mesh_stack = threading.local()
 
 
 def _install_shard_map() -> None:
@@ -41,19 +75,54 @@ def _install_shard_map() -> None:
         check_vma: bool | None = None,
         **kwargs: Any,
     ):
+        manual = (
+            frozenset(mesh.axis_names)
+            if axis_names is None
+            else frozenset(axis_names)
+        )
         if axis_names is not None:
-            kwargs['auto'] = frozenset(mesh.axis_names) - frozenset(
-                axis_names
-            )
+            kwargs['auto'] = frozenset(mesh.axis_names) - manual
         if check_vma is not None:
             kwargs['check_rep'] = check_vma
         else:
             kwargs.setdefault('check_rep', False)
+
+        # legacy installs drop the auto set after staging; record the
+        # manual-axes context around the body so get_abstract_mesh (below)
+        # can answer trace-time dispatch probes
+        def body(*args: Any, **kw: Any):
+            stack = getattr(_mesh_stack, 'stack', None)
+            if stack is None:
+                stack = _mesh_stack.stack = []
+            stack.append(_CompatAbstractMesh(mesh.axis_names, manual))
+            try:
+                return f(*args, **kw)
+            finally:
+                stack.pop()
+
         return _legacy(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            **kwargs
         )
 
     jax.shard_map = shard_map
+
+
+def _install_typeof() -> None:
+    if hasattr(jax, 'typeof'):
+        return
+    jax.typeof = jax.core.get_aval
+
+
+def _install_get_abstract_mesh() -> None:
+    if hasattr(jax.sharding, 'get_abstract_mesh'):
+        return
+
+    def get_abstract_mesh() -> _CompatAbstractMesh:
+        stack = getattr(_mesh_stack, 'stack', None)
+        return stack[-1] if stack else _EMPTY_ABSTRACT_MESH
+
+    jax.sharding.get_abstract_mesh = get_abstract_mesh
 
 
 def _install_pcast() -> None:
@@ -69,3 +138,5 @@ def _install_pcast() -> None:
 
 _install_shard_map()
 _install_pcast()
+_install_typeof()
+_install_get_abstract_mesh()

@@ -42,10 +42,27 @@ ARTIFACT_PATH = os.path.join(
 ENV_VAR = 'KFAC_TPU_DISPATCH_TABLE'
 
 #: prior thresholds (the constants the gates shipped with) — the
-#: derivation's starting point and the load-or-default fallback
+#: derivation's starting point and the load-or-default fallback. The
+#: fused step-path families (cov_ema, ns, klclip) start at conservative
+#: priors sized off the unfused kernels' win regimes; only a clean sweep
+#: moves them (docs/ARCHITECTURE.md "Fused step-path kernels").
 DEFAULTS: dict[str, Any] = {
     'cov': {'min_dim': 256, 'dtypes': ['float32']},
     'attn': {'min_sk_dense': 2048},
+    'cov_ema': {'min_dim': 256, 'dtypes': ['float32']},
+    'ns': {'min_dim': 512},
+    'klclip': {'min_dim': 512},
+}
+
+#: microbench op-name prefix of each family's BASELINE (unfused) sweep —
+#: what :func:`floor_contaminated` scans the artifact provenance for, and
+#: what :func:`derive_tables` writes verdicts under
+BASELINE_SWEEP_PREFIX: dict[str, str] = {
+    'cov': 'cov_dense',
+    'attn': 'attn_einsum',
+    'cov_ema': 'cov_ema_unfused',
+    'ns': 'ns_unfused',
+    'klclip': 'klclip_unfused',
 }
 
 #: a dtype must win at this many distinct sweep sizes before the
@@ -175,11 +192,70 @@ def flash_min_sk_dense(default: int) -> int:
     return int(v) if isinstance(v, (int, float)) and v > 0 else default
 
 
+def family_min_dim(family: str, default: int) -> int:
+    """Smallest swept dim the named fused family wins at (generic
+    accessor for the cov_ema/ns/klclip gates)."""
+    v = _get(load_tables(), family, 'min_dim')
+    return int(v) if isinstance(v, (int, float)) and v > 0 else default
+
+
+def family_dtypes(
+    family: str, default: Sequence[str] = ('float32',)
+) -> tuple[str, ...]:
+    """Input dtype names the named fused family wins at."""
+    v = _get(load_tables(), family, 'dtypes')
+    if isinstance(v, (list, tuple)) and all(isinstance(s, str) for s in v):
+        return tuple(v)
+    return tuple(default)
+
+
+def floor_contaminated(family: str) -> str | None:
+    """Name of the latency-floor-contaminated sweep backing the family's
+    threshold, or None when the backing evidence is clean.
+
+    A threshold whose BASELINE sweep was flagged by
+    :func:`latency_floor_verdict` never measured the op — every number in
+    it is the dispatch floor — so the gates must not trust it: they hold
+    the conservative (XLA) default instead and name the sweep in a
+    once-per-family warning (``kfac_tpu.warnings.warn_dispatch_event``).
+    Scans the loaded artifact's ``provenance.contaminated`` keys for the
+    family's baseline prefix (:data:`BASELINE_SWEEP_PREFIX`).
+    """
+    prefix = BASELINE_SWEEP_PREFIX.get(family, family)
+    prov = load_tables().get('provenance')
+    if not isinstance(prov, Mapping):
+        return None
+    cont = prov.get('contaminated')
+    if not isinstance(cont, Mapping):
+        return None
+    for key in sorted(cont):
+        if key == prefix or key.startswith(prefix + '_'):
+            verdict = cont[key]
+            if isinstance(verdict, Mapping) and not verdict.get(
+                'contaminated', True
+            ):
+                continue
+            return key
+    return None
+
+
 # ---------------------------------------------------------------- derivation
 
 _COV_RE = re.compile(r'^cov_(dense|pallas)_(\d+)_(f32|bf16)$')
 _ATTN_RE = re.compile(r'^attn_(einsum|flash)_s(\d+)$')
+_FUSED_RE = re.compile(
+    r'^(cov_ema|ns|klclip)_(unfused|fused)_(\d+)(?:_f32)?$'
+)
 _DTYPE_NAME = {'f32': 'float32', 'bf16': 'bfloat16'}
+
+#: work ~ size**exponent for each fused family's floor verdict: the
+#: cov+EMA contraction is n·d² at fixed rows, one NS iteration is two
+#: (d,d) matmuls (d³), the kl-clip contraction+apply is elementwise d²
+FUSED_WORK_EXPONENT: dict[str, float] = {
+    'cov_ema': 2.0,
+    'ns': 3.0,
+    'klclip': 2.0,
+}
 
 
 def _best_ms(ops: Iterable[Mapping[str, Any]]) -> dict[str, float]:
@@ -297,9 +373,63 @@ def derive_tables(
             f'only {len(wins)} winning length(s) < {min_win_points}; '
             'prior stands'
         )
+    # --- fused step-path families: fused vs unfused per size ------------
+    fused_series: dict[str, dict[str, dict[int, float]]] = {}
+    for name, ms in best.items():
+        m = _FUSED_RE.match(name)
+        if m:
+            fam, impl, d = m.group(1), m.group(2), int(m.group(3))
+            fused_series.setdefault(fam, {}).setdefault(impl, {})[d] = ms
+    fused_out: dict[str, dict[str, Any]] = {}
+    for fam in ('cov_ema', 'ns', 'klclip'):
+        fam_prior = dict(prior.get(fam, DEFAULTS[fam]))
+        fam_min = int(fam_prior.get('min_dim', DEFAULTS[fam]['min_dim']))
+        impls = fused_series.get(fam, {})
+        unfused = impls.get('unfused', {})
+        fused = impls.get('fused', {})
+        both = sorted(set(unfused) & set(fused))
+        verdict = latency_floor_verdict(
+            both,
+            [unfused[d] * 1e-3 for d in both],
+            work_exponent=FUSED_WORK_EXPONENT[fam],
+            flat_tol=flat_tol,
+        )
+        if verdict and verdict['contaminated']:
+            provenance['contaminated'][f'{fam}_unfused'] = verdict
+            provenance['held'][fam] = (
+                'baseline sweep is latency-floor contaminated; threshold '
+                'held at prior'
+            )
+        elif both:
+            wins = [d for d in both if fused[d] < unfused[d]]
+            if len(wins) < min_win_points:
+                provenance['held'][fam] = (
+                    f'only {len(wins)} winning size(s) < {min_win_points}; '
+                    'prior stands'
+                )
+            else:
+                suffix = None
+                for d in sorted(both, reverse=True):
+                    if d in wins:
+                        suffix = d
+                    else:
+                        break
+                if suffix is None:
+                    provenance['held'][fam] = (
+                        'wins are not a suffix of the sweep (no clean win '
+                        'regime); prior stands'
+                    )
+                else:
+                    fam_min = suffix
+                    provenance.setdefault('derived', {})[fam] = {
+                        'win_from_dim': suffix, 'sizes': both,
+                    }
+        fam_prior['min_dim'] = fam_min
+        fused_out[fam] = fam_prior
     return {
         'schema': SCHEMA_VERSION,
         'cov': {'min_dim': min_dim, 'dtypes': sorted(dtypes)},
         'attn': {'min_sk_dense': min_sk},
+        **fused_out,
         'provenance': provenance,
     }

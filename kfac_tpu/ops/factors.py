@@ -308,11 +308,28 @@ def newton_schulz_inverse_info(
         _, _, resid, prev, k = carry
         return (k < max_iters) & (resid > tol) & (resid < prev)
 
-    def body(carry):
-        x, mx, resid, _, k = carry
+    # trace-time dispatch of the iteration body: in the fused kernel's
+    # win regime (TPU, whole tiles, artifact-backed — see
+    # pallas_ns.use_fused_ns_for) the two matmuls and the residual
+    # reduction run as the fused Pallas pair, feeding the stopping rule
+    # an identical residual; everywhere else the XLA expressions below
+    from kfac_tpu.ops import pallas_ns
+
+    use_fused = factor.ndim == 2 and pallas_ns.use_fused_ns_for(d)
+
+    def step(x, mx):
+        if use_fused:
+            return pallas_ns.fused_ns_step(
+                m, x, mx, interpret=pallas_ns.interpret_mode()
+            )
         x_new = x @ (2.0 * eye - mx)
         mx_new = m @ x_new
-        return x_new, mx_new, residual(mx_new), resid, k + 1
+        return x_new, mx_new, residual(mx_new)
+
+    def body(carry):
+        x, mx, resid, _, k = carry
+        x_new, mx_new, r_new = step(x, mx)
+        return x_new, mx_new, r_new, resid, k + 1
 
     if x0 is not None:
         # safeguarded warm start: keep the caller's init only if it is
@@ -341,12 +358,11 @@ def newton_schulz_inverse_info(
         def scan_body(carry, _):
             x, mx, resid, prev, k = carry
             active = (resid > tol) & (resid < prev)
-            x_new = x @ (2.0 * eye - mx)
-            mx_new = m @ x_new
+            x_new, mx_new, r_new = step(x, mx)
             x = jnp.where(active, x_new, x)
             mx = jnp.where(active, mx_new, mx)
             prev = jnp.where(active, resid, prev)
-            resid = jnp.where(active, residual(mx_new), resid)
+            resid = jnp.where(active, r_new, resid)
             k = k + active.astype(jnp.int32)
             return (x, mx, resid, prev, k), None
 
@@ -541,3 +557,52 @@ def kl_clip_scale(
     safe = jnp.where(vg_abs == 0.0, 1.0, vg_abs)
     scale = jnp.minimum(1.0, jnp.sqrt(kl_clip / safe))
     return jnp.where(vg_abs == 0.0, 1.0, scale)
+
+
+def kl_clip_terms(
+    pmat: jax.Array,
+    gmat: jax.Array,
+    lr: float | jax.Array,
+) -> jax.Array:
+    """One layer's term of the kl-clip second moment:
+    ``sum(pmat * gmat) * lr^2`` in f32.
+
+    This is the contraction every engine sums across layers before
+    :func:`kl_clip_scale`. In the fused kernel's win regime
+    (:func:`kfac_tpu.ops.pallas_ns.use_fused_klclip_for`) the
+    multiply-reduce runs tiled in VMEM; everywhere else it is the plain
+    XLA expression — bitwise-identical inputs either way.
+    """
+    from kfac_tpu.ops import pallas_ns
+
+    if (
+        pmat.ndim == 2
+        and pmat.shape == gmat.shape
+        and pallas_ns.use_fused_klclip_for(pmat.shape)
+    ):
+        dot = pallas_ns.fused_klclip_dot(
+            pmat, gmat, interpret=pallas_ns.interpret_mode()
+        )
+    else:
+        dot = jnp.sum(
+            pmat.astype(jnp.float32) * gmat.astype(jnp.float32)
+        )
+    return dot * (lr ** 2)
+
+
+def kl_clip_apply(pmat: jax.Array, scale: jax.Array) -> jax.Array:
+    """Apply the kl-clip scale to one preconditioned gradient:
+    ``(pmat_f32 * scale)`` cast back to ``pmat``'s dtype.
+
+    The fused Pallas form runs the f32 upcast + scale tiled in VMEM in
+    its win regime; the fallback is the engines' original expression.
+    """
+    from kfac_tpu.ops import pallas_ns
+
+    if pmat.ndim == 2 and pallas_ns.use_fused_klclip_for(pmat.shape):
+        out = pallas_ns.fused_klclip_scale(
+            pmat, scale, interpret=pallas_ns.interpret_mode()
+        )
+    else:
+        out = pmat.astype(jnp.float32) * scale
+    return out.astype(pmat.dtype)

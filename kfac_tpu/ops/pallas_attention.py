@@ -327,13 +327,25 @@ def use_flash_for(
     as the load-or-default fallback — because its alternative is XLA's
     fully-fused attention rather than the unfused einsum partials.
     Overridable via ``KFAC_TPU_PALLAS``
-    (:mod:`kfac_tpu.ops.pallas_gate`)."""
+    (:mod:`kfac_tpu.ops.pallas_gate`). A latency-floor-contaminated
+    baseline sweep in the artifact provenance voids the dense-path
+    threshold: the gate holds the conservative XLA default for the dense
+    path and warns once, naming the sweep (the blockwise-partials path
+    has no length floor and stays available)."""
+    from kfac_tpu import warnings as kfac_warnings
     from kfac_tpu.ops import dispatch_tables, pallas_gate
 
+    if not (
+        pallas_gate.enabled('attn') and jax.default_backend() == 'tpu'
+    ):
+        return False
+    if dense:
+        sweep = dispatch_tables.floor_contaminated('attn')
+        if sweep is not None:
+            kfac_warnings.warn_dispatch_event('attn', sweep)
+            return False
     return (
-        pallas_gate.enabled('attn')
-        and jax.default_backend() == 'tpu'
-        and s_q % BLOCK_Q == 0
+        s_q % BLOCK_Q == 0
         and s_k % BLOCK_K == 0
         and (not dense or s_k >= dispatch_tables.flash_min_sk_dense(
             default=_MIN_FLASH_SK_DENSE

@@ -184,13 +184,23 @@ def use_pallas_for(d: int, dtype) -> bool:
 
     ``dtype`` is required so a call site cannot silently re-open the
     measured-loss bf16 regime. Overridable via ``KFAC_TPU_PALLAS``
-    (:mod:`kfac_tpu.ops.pallas_gate`)."""
+    (:mod:`kfac_tpu.ops.pallas_gate`). When the committed artifact's own
+    provenance marks the backing baseline sweep latency-floor
+    contaminated, the gate does not trust the threshold at all: it holds
+    the conservative XLA default and warns once, naming the sweep."""
+    from kfac_tpu import warnings as kfac_warnings
     from kfac_tpu.ops import dispatch_tables, pallas_gate
 
+    if not (
+        pallas_gate.enabled('cov') and jax.default_backend() == 'tpu'
+    ):
+        return False
+    sweep = dispatch_tables.floor_contaminated('cov')
+    if sweep is not None:
+        kfac_warnings.warn_dispatch_event('cov', sweep)
+        return False
     return (
-        pallas_gate.enabled('cov')
-        and jax.default_backend() == 'tpu'
-        and d >= dispatch_tables.cov_min_dim(default=2 * TILE)
+        d >= dispatch_tables.cov_min_dim(default=2 * TILE)
         and jnp.dtype(dtype).name in dispatch_tables.cov_dtypes(
             default=('float32',)
         )

@@ -1,0 +1,266 @@
+"""Fused Pallas TPU kernel: covariance contraction + factor EMA.
+
+Every engine's capture path runs ``get_cov`` (a^T a / scale) immediately
+followed by ``ema_update`` (F <- beta*F + (1-beta)*cov) — two kernels
+with a full (d, d) f32 round-trip through HBM between them, plus the
+defensive symmetrization the unfused contraction needs. This module
+extends the triangular :mod:`pallas_cov` kernel with an EMA epilogue:
+at the last reduction step of each on-or-above-diagonal output tile the
+kernel reads the matching tile of the running factor and blends in
+place, so the covariance intermediate never exists in HBM
+(``F <- beta*F + (1-beta)*a^T a/scale`` in one pass) and the result is
+exactly symmetric by the same mirror-the-upper-triangle construction —
+no ``(C + C^T)/2`` needed.
+
+Equivalence contract (pinned by tests/ops/test_fused_kernels.py): for
+f32 inputs, ``fused_cov_ema(F, a, alpha, scale)`` is allclose to
+``ema_update(F, get_cov(a, scale), alpha)`` and exactly symmetric for
+symmetric ``F``.
+
+GSPMD integration mirrors :func:`pallas_cov.sym_cov_spmd` — local rows
+plus psum — with one twist the EMA blend forces: the psum over row
+shards must reproduce ``beta*F`` exactly once, so each shard blends with
+``beta/nshards`` and the all-reduce reassembles
+``sum_s (beta/nshards)*F + c*acc_s = beta*F + c*sum_s acc_s``.
+
+Dispatch (:func:`use_fused_cov_ema_for`) follows the family's row in the
+committed threshold artifact (:mod:`kfac_tpu.ops.dispatch_tables`,
+family ``cov_ema``); off-TPU, below threshold, or under a contaminated
+baseline sweep the caller falls back to the unfused pair.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kfac_tpu.ops.pallas_cov import (
+    K_BLOCK, TILE, _pad_to, interpret_mode,
+)
+
+
+def _sym_cov_ema_kernel(a_i_ref, a_j_ref, f_ref, out_ref, *, beta, coeff):
+    """Triangular cov tile with the EMA blend fused into the epilogue.
+
+    ``beta``/``coeff`` are trace-time constants (the gate only fires for
+    static decay factors): ``out = beta*F + coeff*(a^T a)`` at the last
+    reduction step, where ``coeff = (1-beta)/scale``.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(j >= i)
+    def _accumulate():
+        out_ref[:] += jax.lax.dot_general(
+            a_i_ref[:], a_j_ref[:],
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # epilogue: the running-factor tile is read once, at the step where
+    # the accumulated a^T a tile is complete and still VMEM-resident —
+    # the unfused pair's d^2 HBM round-trip is exactly this read-modify-
+    # write, done here for free
+    @pl.when((j >= i) & (k == pl.num_programs(2) - 1))
+    def _ema():
+        out_ref[:] = (
+            beta * f_ref[:].astype(jnp.float32) + coeff * out_ref[:]
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=('beta', 'coeff', 'interpret')
+)
+def _fused(
+    f: jax.Array,
+    a: jax.Array,
+    beta: float,
+    coeff: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Padded kernel launch + lower-triangle mirror; returns f32 (d, d).
+
+    ``f`` is the (d, d) running factor, ``a`` the (n, d) activation
+    rows; the blend is ``beta*f + coeff*(a^T a)``.
+    """
+    n, d = a.shape
+    n_pad = -(-n // K_BLOCK) * K_BLOCK
+    d_pad = -(-d // TILE) * TILE
+    ap = _pad_to(a, n_pad, d_pad)
+    fp = _pad_to(f.astype(jnp.float32), d_pad, d_pad)
+    nblk = d_pad // TILE
+    nk = n_pad // K_BLOCK
+
+    vma = getattr(jax.typeof(ap), 'vma', None)
+    out_shape = (
+        jax.ShapeDtypeStruct((d_pad, d_pad), jnp.float32, vma=vma)
+        if vma is not None
+        else jax.ShapeDtypeStruct((d_pad, d_pad), jnp.float32)
+    )
+    upper = pl.pallas_call(
+        functools.partial(
+            _sym_cov_ema_kernel, beta=beta, coeff=coeff
+        ),
+        out_shape=out_shape,
+        grid=(nblk, nblk, nk),
+        in_specs=[
+            pl.BlockSpec((K_BLOCK, TILE), lambda i, j, k: (k, i)),
+            pl.BlockSpec((K_BLOCK, TILE), lambda i, j, k: (k, j)),
+            pl.BlockSpec((TILE, TILE), lambda i, j, k: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((TILE, TILE), lambda i, j, k: (i, j)),
+        interpret=interpret,
+    )(ap, ap, fp)
+
+    # mirror the blended upper-triangle blocks; symmetric F means the
+    # mirrored tile equals the directly-blended one would have
+    rows = jnp.arange(d_pad)[:, None] // TILE
+    cols = jnp.arange(d_pad)[None, :] // TILE
+    full = jnp.where(cols >= rows, upper, upper.T)
+    return full[:d, :d]
+
+
+@functools.partial(custom_partitioning, static_argnums=(2, 3))
+def sym_cov_ema_spmd(
+    f: jax.Array, a: jax.Array, beta: float, coeff: float
+) -> jax.Array:
+    """GSPMD-partitionable fused cov+EMA: row-sharded activations blend
+    per-shard with ``beta/nshards`` and psum over the row axes (the same
+    local-rows schedule as :func:`pallas_cov.sym_cov_spmd`, carrying the
+    EMA through the all-reduce)."""
+    return _fused(f, a, beta, coeff, interpret=interpret_mode())
+
+
+def _spmd_infer(beta, coeff, mesh, arg_shapes, result_shape):
+    del beta, coeff, arg_shapes, result_shape
+    return NamedSharding(mesh, P())
+
+
+def _spmd_partition(beta, coeff, mesh, arg_shapes, result_shape):
+    del result_shape
+    spec = arg_shapes[1].sharding.spec
+    row_axes = spec[0] if len(spec) > 0 else None
+    nshards = 1
+    if row_axes is not None:
+        axes = row_axes if isinstance(row_axes, tuple) else (row_axes,)
+        for ax in axes:
+            nshards *= int(mesh.shape[ax])
+
+    def lower(f, a):
+        out = _fused(
+            f, a, beta / nshards, coeff, interpret=interpret_mode()
+        )
+        if row_axes is not None:
+            out = jax.lax.psum(out, row_axes)
+        return out
+
+    # the running factor is replicated (every shard blends its beta/s
+    # share); activation rows stay on their shard, features gather
+    arg_shardings = (
+        NamedSharding(mesh, P()),
+        NamedSharding(mesh, P(row_axes, None)),
+    )
+    return mesh, lower, NamedSharding(mesh, P()), arg_shardings
+
+
+try:
+    sym_cov_ema_spmd.def_partition(
+        infer_sharding_from_operands=_spmd_infer,
+        partition=_spmd_partition,
+        # fresh output factors, rows drive the psum — same rule shape as
+        # sym_cov_spmd with the replicated running factor prepended
+        sharding_rule='e1 e2, n d1 -> d2 d3',
+    )
+except TypeError:
+    sym_cov_ema_spmd.def_partition(
+        infer_sharding_from_operands=_spmd_infer,
+        partition=_spmd_partition,
+    )
+
+
+def use_fused_cov_ema_for(d: int, dtype) -> bool:
+    """Dispatch the fused cov+EMA kernel only in its artifact-backed win
+    regime (family ``cov_ema``), with the same conservative holds as the
+    other gates: off-TPU and contaminated-baseline sweeps never dispatch
+    (:func:`dispatch_tables.floor_contaminated`)."""
+    from kfac_tpu import warnings as kfac_warnings
+    from kfac_tpu.ops import dispatch_tables, pallas_gate
+
+    if not (
+        pallas_gate.enabled('cov_ema')
+        and jax.default_backend() == 'tpu'
+    ):
+        return False
+    sweep = dispatch_tables.floor_contaminated('cov_ema')
+    if sweep is not None:
+        kfac_warnings.warn_dispatch_event('cov_ema', sweep)
+        return False
+    return (
+        d >= dispatch_tables.family_min_dim('cov_ema', default=2 * TILE)
+        and jnp.dtype(dtype).name in dispatch_tables.family_dtypes(
+            'cov_ema', default=('float32',)
+        )
+    )
+
+
+def fused_cov_ema(
+    running: jax.Array | None,
+    a: jax.Array,
+    alpha: float,
+    scale=None,
+) -> jax.Array:
+    """Drop-in fusion of ``ema_update(running, get_cov(a, scale), alpha)``.
+
+    Dispatches the fused kernel in its win regime (TPU, artifact-backed
+    threshold, fully-manual or fully-automatic trace context); otherwise
+    runs the unfused pair, so callers never need their own fallback.
+    ``running=None`` follows ``ema_update``'s cold-start semantics
+    (identity running factor). Returns the running factor's dtype (f32
+    accumulation inside either path).
+    """
+    from kfac_tpu.ops import cov as cov_lib
+    from kfac_tpu.ops import factors, pallas_gate
+
+    n, d = a.shape
+    if scale is None:
+        scale = n
+
+    if not (
+        isinstance(alpha, (int, float))
+        and use_fused_cov_ema_for(d, a.dtype)
+    ):
+        return factors.ema_update(
+            running, cov_lib.get_cov(a, scale=scale), alpha
+        )
+
+    if running is None:
+        # ema_update's cold start: identity in the covariance's dtype
+        running = jnp.eye(d, dtype=a.dtype)
+    out_dtype = jnp.promote_types(running.dtype, a.dtype)
+
+    beta = float(alpha)
+    coeff = (1.0 - beta) / float(scale)
+    # same trace-context split as get_cov: fully-manual shard_map runs
+    # the raw kernel on local rows, no-manual contexts go through the
+    # custom_partitioning wrapper, partial-manual falls back to the
+    # unfused pair (neither kernel form traces there)
+    _has_mesh, manual_any, manual_all = pallas_gate.manual_context()
+    if manual_all:
+        out = _fused(running, a, beta, coeff, interpret=interpret_mode())
+    elif not manual_any:
+        out = sym_cov_ema_spmd(running, a, beta, coeff)
+    else:
+        return factors.ema_update(
+            running, cov_lib.get_cov(a, scale=scale), alpha
+        )
+    return out.astype(out_dtype)

@@ -1390,9 +1390,7 @@ class DistributedKFAC:
                     metrics_out[f'precond_grad_norm/{name}'] = jnp.sqrt(
                         jnp.sum(p32 * p32))
                 if cfg.kl_clip is not None:
-                    vg = vg + jnp.sum(
-                        pmat.astype(jnp.float32) * gmat.astype(jnp.float32)
-                    ) * (lr**2)
+                    vg = vg + factors_lib.kl_clip_terms(pmat, gmat, lr)
                 mats[name] = pmat
 
         if cfg.kl_clip is not None:
@@ -1411,7 +1409,7 @@ class DistributedKFAC:
             helper = self.registry.layers[name]
             ref_dtype = layer_grads[name][next(iter(layer_grads[name]))].dtype
             if scale is not None:
-                pmat = pmat * scale
+                pmat = factors_lib.kl_clip_apply(pmat, scale)
                 if mcfg is not None and mcfg.grad_norms:
                     metrics_out[f'precond_grad_norm/{name}'] = (
                         metrics_out[f'precond_grad_norm/{name}']

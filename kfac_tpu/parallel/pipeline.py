@@ -1190,10 +1190,9 @@ class PipelineKFAC:
                             gmat, qa_, qg_
                         )
                     if cfg.kl_clip is not None:
-                        vg = vg + jnp.sum(
-                            pmat.astype(jnp.float32)
-                            * gmat.astype(jnp.float32)
-                        ) * (lr**2)
+                        vg = vg + factors_lib.kl_clip_terms(
+                            pmat, gmat, lr
+                        )
                     pre[name] = pmat
                 per_ci.append(
                     (new_a, new_g, new_qa, new_qg, new_da, new_dg,
@@ -1214,7 +1213,9 @@ class PipelineKFAC:
                 out_grads = sgrads
                 for name in names:
                     h = helpers[name]
-                    new_leaves = h.matrix_to_grads(pre[name] * scale)
+                    new_leaves = h.matrix_to_grads(
+                        factors_lib.kl_clip_apply(pre[name], scale)
+                    )
                     out_grads = registry_lib.merge_layer_grads(
                         out_grads, {name: new_leaves},
                         registry_lib.Registry(

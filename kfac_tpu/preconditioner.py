@@ -942,10 +942,7 @@ class KFACPreconditioner:
                 metrics_out[f'precond_grad_norm/{name}'] = jnp.sqrt(
                     jnp.sum(p32 * p32))
             if self.kl_clip is not None:
-                vg_terms.append(
-                    jnp.sum(pmat.astype(jnp.float32) * gmat.astype(jnp.float32))
-                    * (lr**2)
-                )
+                vg_terms.append(factors_lib.kl_clip_terms(pmat, gmat, lr))
             precond[name] = (pmat, helper)
         if self.kl_clip is not None and vg_terms:
             kl_clip = _resolve(self.kl_clip, state.step)
@@ -962,7 +959,7 @@ class KFACPreconditioner:
         out: dict[str, dict[str, jax.Array]] = {}
         for name, (pmat, helper) in precond.items():
             if scale is not None:
-                pmat = (pmat.astype(jnp.float32) * scale).astype(pmat.dtype)
+                pmat = factors_lib.kl_clip_apply(pmat, scale)
                 if mcfg is not None and mcfg.grad_norms:
                     metrics_out[f'precond_grad_norm/{name}'] = (
                         metrics_out[f'precond_grad_norm/{name}']

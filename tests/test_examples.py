@@ -5,6 +5,7 @@ import sys
 import pytest
 
 
+@pytest.mark.slow
 def test_cifar_example_smoke(monkeypatch):
     from examples import train_cifar_resnet
 
@@ -117,6 +118,7 @@ def test_lm_example_with_tp_and_sp():
     assert ppl > 0
 
 
+@pytest.mark.slow
 def test_cifar_example_no_kfac():
     from examples import train_cifar_resnet
 

@@ -707,6 +707,99 @@ def main():
                         args.iters, post=cov_check,
                     ))
 
+            # fused step-path kernels vs their unfused XLA expressions
+            # (interpret mode off-TPU: numerics-true, and the derivation
+            # can only HOLD priors on a losing or contaminated sweep —
+            # committed CPU evidence never opens a fused gate)
+            if run_pallas:
+                from kfac_tpu.ops import pallas_cov_ema, pallas_ns
+
+                interp = pallas_ns.interpret_mode()
+                beta = 0.95
+                coeff = (1.0 - beta) / args.rows
+                f0 = cov + jnp.eye(d, dtype=jnp.float32)
+
+                def ema_unfused(f, a, _beta=beta, _coeff=coeff):
+                    acc = jax.lax.dot_general(
+                        a, a, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    return _beta * f + _coeff * acc
+
+                track('cov_ema_unfused', 2.0, d, measured(
+                    f'cov_ema_unfused_{d}_f32',
+                    lambda n: timeit(jax.jit(ema_unfused), f0, m, iters=n),
+                    args.iters,
+                ))
+                track('cov_ema_fused', 2.0, d, measured(
+                    f'cov_ema_fused_{d}_f32',
+                    lambda n: timeit(
+                        jax.jit(lambda f, a: pallas_cov_ema._fused(
+                            f, a, beta, coeff, interpret=interp
+                        )),
+                        f0, m, iters=n,
+                    ),
+                    args.iters,
+                ))
+
+                damping = 0.003
+                m_spd = cov + damping * jnp.eye(d, dtype=jnp.float32)
+                x0 = jnp.eye(d, dtype=jnp.float32) / jnp.trace(m_spd)
+                mx0 = m_spd @ x0
+
+                def ns_unfused(mm, x, mx):
+                    eye = jnp.eye(mm.shape[-1], dtype=jnp.float32)
+                    x_new = x @ (2.0 * eye - mx)
+                    mx_new = mm @ x_new
+                    r = jnp.linalg.norm(eye - mx_new) / jnp.sqrt(
+                        jnp.float32(mm.shape[-1])
+                    )
+                    return x_new, mx_new, r
+
+                track('ns_unfused', 3.0, d, measured(
+                    f'ns_unfused_{d}',
+                    lambda n: timeit(jax.jit(ns_unfused), m_spd, x0, mx0,
+                                     iters=n),
+                    args.iters,
+                ))
+                if d % pallas_ns.TILE == 0:
+                    track('ns_fused', 3.0, d, measured(
+                        f'ns_fused_{d}',
+                        lambda n: timeit(
+                            jax.jit(
+                                lambda mm, x, mx: pallas_ns.fused_ns_step(
+                                    mm, x, mx, interpret=interp
+                                )
+                            ),
+                            m_spd, x0, mx0, iters=n,
+                        ),
+                        args.iters,
+                    ))
+
+                gmat = 0.5 * cov + 0.1 * jnp.eye(d, dtype=jnp.float32)
+
+                def kl_unfused(p, g):
+                    return p * jnp.sum(p * g)
+
+                def kl_fused(p, g):
+                    s = pallas_ns.fused_klclip_dot(p, g, interpret=interp)
+                    return pallas_ns.fused_klclip_scale(
+                        p, s, interpret=interp
+                    )
+
+                track('klclip_unfused', 2.0, d, measured(
+                    f'klclip_unfused_{d}',
+                    lambda n: timeit(jax.jit(kl_unfused), cov, gmat,
+                                     iters=n),
+                    args.iters,
+                ))
+                track('klclip_fused', 2.0, d, measured(
+                    f'klclip_fused_{d}',
+                    lambda n: timeit(jax.jit(kl_fused), cov, gmat,
+                                     iters=n),
+                    args.iters,
+                ))
+
     if sweeps:
         report_floor_verdicts(sweeps)
 
