@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults async compress fleet obs prof tune resilience lint lint-ir lint-pod inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults async compress fleet chaos obs prof tune resilience lint lint-ir lint-pod inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -56,6 +56,14 @@ compress:
 fleet:
 	$(TEST_ENV) $(PY) -m pytest tests/test_fleet.py -q
 
+# pod-scale chaos harness: CLI selftest (processless reconcile/grammar
+# checks) + the chaos suite including the deterministic 4-proc scripted
+# storm against a real gloo pod; the 16-proc seeded storm rides behind
+# the `slow` marker (see docs/ROBUSTNESS.md "Chaos harness")
+chaos:
+	$(TEST_ENV) $(PY) tools/kfac_chaos.py --selftest
+	$(TEST_ENV) $(PY) -m pytest tests/test_chaos.py -q -m 'not slow'
+
 # measurement-truth layer (docs/OBSERVABILITY.md "Measurement truth"):
 # a real microbench smoke sweep on the CPU backend (fori_loop one-
 # dispatch provenance + latency-floor verdicts over an actual size
@@ -77,11 +85,11 @@ prof:
 # measurement-truth layer (prof: dispatch-free microbench, threshold
 # derivation, calibration), the unified static-analysis pass (which
 # includes the named-scope, metric-key, plan-schema, compression-knob,
-# fleet-knob, calibration-knob and topology-knob lints as
-# KFL101-KFL103/KFL105/KFL106/KFL108/KFL109 plus the IR-tier smoke pass via
-# lint-ir), and the kfac_inspect analysis selftest
+# fleet-knob, calibration-knob, topology-knob and chaos-knob lints as
+# KFL101-KFL103/KFL105/KFL106/KFL108/KFL109/KFL111 plus the IR-tier
+# smoke pass via lint-ir), and the kfac_inspect analysis selftest
 # (see docs/OBSERVABILITY.md)
-obs: async lint compress fleet prof
+obs: async lint compress fleet chaos prof
 	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py \
 		tests/test_flight_recorder.py -q
 	$(PY) tools/kfac_inspect.py --selftest

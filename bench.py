@@ -597,6 +597,34 @@ def _pipeline_probe() -> dict:
     }
 
 
+def _chaos_probe() -> dict:
+    """Chaos-harness recovery SLOs (docs/ROBUSTNESS.md "Chaos harness").
+
+    Folds the committed storm artifact
+    (``kfac_tpu/resilience/chaos_slo.json``, written by
+    ``tools/kfac_chaos.py --out``) into the round JSON: per fault class
+    the measured downtime steps, recovery wall-clock, restore fallback
+    depth, and worst divergence vs the uninterrupted control run, plus
+    the storm's shape and whether every SLO budget held. Read-only — a
+    storm spawns a real multi-process pod (minutes), so bench rounds
+    publish the last measured storm rather than re-running one.
+    """
+    from kfac_tpu.resilience import chaos
+
+    artifact = chaos.load_slo_artifact()
+    if artifact is None:
+        return {'status': 'missing'}
+    cfg = artifact.get('config', {})
+    return {
+        'status': 'ok' if artifact.get('ok') else 'blown',
+        'rows': artifact['rows'],
+        'procs': cfg.get('procs'),
+        'max_steps': cfg.get('max_steps'),
+        'schedule': [e.get('fault') for e in artifact.get('schedule', ())],
+        'blown': artifact.get('blown', []),
+    }
+
+
 def _fused_kernel_probe(d: int = 256, rows: int = 512) -> dict:
     """Within-run A/B of the fused step-path kernels vs their unfused
     XLA expressions (docs/ARCHITECTURE.md "Fused step-path kernels").
@@ -863,6 +891,11 @@ def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     _atomic_write(out_path, result)
     _log('  fused kernel probe (cov+EMA / NS / kl-clip, fused vs unfused)')
     result['fused_kernel_probe'] = _fused_kernel_probe()
+
+    # chaos-harness SLOs: committed storm artifact, read-only
+    _atomic_write(out_path, result)
+    _log('  chaos probe (preemption-storm recovery SLOs, committed artifact)')
+    result['chaos_probe'] = _chaos_probe()
 
 
 # ---------------------------------------------------------------------------
@@ -1407,6 +1440,10 @@ _HEADLINE_KEYS = (
     # traced device attribution (docs/ARCHITECTURE.md "Fused step-path
     # kernels")
     'fused_kernel_probe',
+    # chaos-harness recovery SLOs: per-fault-class downtime / recovery
+    # wall-clock / fallback depth / divergence from the committed storm
+    # artifact (docs/ROBUSTNESS.md "Chaos harness")
+    'chaos_probe',
     # active tuned layout plan, when KFAC_TUNE_PLAN is set (docs/AUTOTUNE.md)
     'tuned_plan',
     # newest committed TPU evidence, replayed when the TPU probe fails

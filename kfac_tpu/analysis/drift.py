@@ -1,4 +1,4 @@
-"""KFL100–KFL109: the migrated docs-vs-code drift linters.
+"""KFL100–KFL111: the migrated docs-vs-code drift linters.
 
 These are ``kind='project'`` rules — unlike the AST rules they import
 the live ``kfac_tpu`` modules and compare real objects (metric schemas,
@@ -537,6 +537,37 @@ def _fused_dispatch_table() -> list[core.Finding]:
     return _doc_findings('KFL110', ARCHITECTURE_DOC, line, problems)
 
 
+# ------------------------------------------------------ KFL111 chaos knobs
+
+
+def check_chaos_knobs(doc_path: str = ROBUSTNESS_DOC) -> list[str]:
+    """Drift between the docs/ROBUSTNESS.md chaos knob table and the
+    ``ChaosConfig`` dataclass fields — the storm-shape and SLO-budget
+    knobs the chaos conductor actually accepts."""
+    import dataclasses
+
+    section, _ = doc_section(doc_path, '### Chaos knobs')
+    documented = table_first_cells(section)
+    from kfac_tpu.resilience import chaos as chaos_lib
+
+    actual = {f.name for f in dataclasses.fields(chaos_lib.ChaosConfig)}
+    problems = []
+    for k in sorted(actual - documented):
+        problems.append(f'undocumented config field (add to {doc_path}): {k}')
+    for k in sorted(documented - actual):
+        problems.append(f'documented knob is not a ChaosConfig field: {k}')
+    return problems
+
+
+def _chaos_knobs() -> list[core.Finding]:
+    try:
+        _, line = doc_section(ROBUSTNESS_DOC, '### Chaos knobs')
+        problems = check_chaos_knobs()
+    except (OSError, ValueError) as exc:
+        return _doc_findings('KFL111', ROBUSTNESS_DOC, 1, [str(exc)])
+    return _doc_findings('KFL111', ROBUSTNESS_DOC, line, problems)
+
+
 # --------------------------------------------------------------- registration
 
 
@@ -661,6 +692,19 @@ core.register(core.Rule(
         'prefix registry) is a kernel whose win regime and fallback '
         'story exist only in folklore',
     check=_fused_dispatch_table,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL111',
+    name='chaos-knobs-doc',
+    what='drift between the docs/ROBUSTNESS.md "Chaos knobs" table and '
+         'the resilience.chaos ChaosConfig dataclass fields',
+    why='the chaos harness is the only measured evidence that the '
+        'preemption/restore stack meets its recovery SLOs; an '
+        'undocumented (or phantom) storm knob means the committed SLO '
+        'artifact was produced by a configuration nobody can reproduce',
+    check=_chaos_knobs,
     kind='project',
 ))
 

@@ -522,6 +522,40 @@ def _retry_health_mismatch(
     ) from exc
 
 
+def _raw_host_restore(path: str) -> dict[str, Any]:
+    """Target-less restore of a checkpoint's full payload to HOST numpy.
+
+    A bare ``StandardCheckpointer.restore(path)`` rebuilds every array
+    with the checkpoint's SAVED sharding, whose serialized device mesh
+    names the WRITER's devices — on an elastic restore after the pod
+    shrank or grew, orbax cannot map those device ids and dies with
+    "available devices are different". Restoring against the checkpoint's
+    own metadata with the sharding stripped forces plain ``np.ndarray``
+    leaves (scalars keep their python types), which never touches device
+    placement; the migration path re-shards through the engine template
+    anyway.
+    """
+    import numpy as np
+
+    from orbax.checkpoint import checkpoint_utils
+
+    reader = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    meta = reader.metadata(path)
+    meta = jax.tree_util.tree_map(
+        lambda m: (
+            dataclasses.replace(m, sharding=None)
+            if dataclasses.is_dataclass(m) and hasattr(m, 'sharding')
+            else m
+        ),
+        meta,
+    )
+    restore_args = checkpoint_utils.construct_restore_args(meta)
+    raw = reader.restore(
+        path, args=ocp.args.PyTreeRestore(restore_args=restore_args)
+    )
+    return jax.tree_util.tree_map(np.asarray, raw)
+
+
 def _migrate_restore(
     path: str,
     engine: Any,
@@ -543,10 +577,12 @@ def _migrate_restore(
         for k in _LAYOUT_KEYS
         if saved_man.get(k) != cur_man.get(k)
     ]
-    # no target shapes needed; materialize to HOST numpy — the raw restore
-    # yields arrays committed to device 0, which would conflict with the
-    # engine's mesh-sharded template inside insert_factors' scatter
-    raw = jax.tree_util.tree_map(np.asarray, ckptr.restore(path))
+    # no target shapes needed; materialized to HOST numpy — a raw restore
+    # through the SAVED shardings would both commit arrays to device 0
+    # (conflicting with the engine's mesh-sharded template inside
+    # insert_factors' scatter) and break outright when the device set
+    # changed (elastic shrink/grow)
+    raw = _raw_host_restore(path)
     factors = _factors_from_saved(raw['kfac'], saved_man)
     if factors is None or 'n_stages' in cur_man:
         raise ValueError(

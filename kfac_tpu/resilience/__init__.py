@@ -13,9 +13,24 @@ cost-model-only fast path, and sustained cross-host drift (flight-
 recorder skew columns) triggers a pod-coordinated live layout migration
 at the next checkpoint boundary. See docs/ROBUSTNESS.md ("Self-driving
 fleet").
+
+``ChaosConductor`` turns all of the above into a measured claim: it
+drives a real multi-process gloo pod through scripted or seeded
+preemption storms (SIGTERM waves, torn checkpoints, topology
+shrink/grow, injected skew) and reconciles per-rank event streams into
+recovery SLO rows — downtime steps, recovery wall-clock, restore
+fallback depth, zero-divergence vs an uninterrupted control run —
+failing loudly when a budget is blown. See docs/ROBUSTNESS.md ("Chaos
+harness").
 """
 
 from kfac_tpu.resilience import signals
+from kfac_tpu.resilience.chaos import (
+    ChaosConductor,
+    ChaosConfig,
+    ChaosError,
+    ChaosReport,
+)
 from kfac_tpu.resilience.fleet import FleetConfig, FleetController
 from kfac_tpu.resilience.manager import (
     CheckpointManager,
@@ -24,6 +39,10 @@ from kfac_tpu.resilience.manager import (
 )
 
 __all__ = [
+    'ChaosConductor',
+    'ChaosConfig',
+    'ChaosError',
+    'ChaosReport',
     'CheckpointManager',
     'FleetConfig',
     'FleetController',

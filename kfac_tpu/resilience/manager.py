@@ -25,6 +25,7 @@ restore onto a different topology. Invariants:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import shutil
@@ -233,10 +234,16 @@ class CheckpointManager:
         return sorted(steps, reverse=True)
 
     def latest_step(self) -> int | None:
-        """The committed ``LATEST`` pointer's step, or None."""
+        """The committed ``LATEST`` pointer's step, or None.
+
+        A torn pointer — truncated or overwritten with garbage bytes by
+        a crashing writer or filesystem rollback — is treated as absent
+        (the bytes are read raw and decoded leniently: a torn pointer
+        must degrade to the rotation-scan fallback, never crash the
+        restore)."""
         try:
-            with open(self._latest_path()) as f:
-                name = f.read().strip()
+            with open(self._latest_path(), 'rb') as f:
+                name = f.read().decode('utf-8', errors='replace').strip()
         except OSError:
             return None
         if not name.startswith(_STEP_PREFIX):
@@ -396,21 +403,37 @@ class CheckpointManager:
         existing checkpoint is pointed at and no second write happens —
         the SIGTERM grace window is too precious to spend re-writing
         bytes that are already safe.
+
+        Signal storms (schedulers re-deliver SIGTERM until the process
+        dies) are dropped for the save's duration: the whole body runs
+        under :func:`signals.save_in_flight`, so a re-delivery of the
+        triggering signal cannot re-arm the flag and re-enter here —
+        only an escalation (SIGTERM during a SIGUSR1 save) still
+        latches.
         """
-        self._flush_pending()
-        if step is None:
-            kstate, _ = _split_train_state(state)
-            step = _host_step(kstate)
-        _warnings.warn(
-            f'emergency checkpoint requested at step {step} ({reason})',
-            CheckpointResilienceWarning,
-            stacklevel=2,
+        # only a SIGNAL-driven save suppresses re-deliveries; a health or
+        # fleet-migration save must still latch an incoming SIGTERM (the
+        # preemption notice outlives this save)
+        bracket = (
+            signals_lib.save_in_flight(reason)
+            if reason in signals_lib.HANDLED_SIGNALS
+            else contextlib.nullcontext()
         )
-        if self._is_committed(step):
-            if self._last_saved_step != step:
-                self._commit(step)
-            return self.checkpoint_path(step)
-        return self.save(state, step=step, block=True)
+        with bracket:
+            self._flush_pending()
+            if step is None:
+                kstate, _ = _split_train_state(state)
+                step = _host_step(kstate)
+            _warnings.warn(
+                f'emergency checkpoint requested at step {step} ({reason})',
+                CheckpointResilienceWarning,
+                stacklevel=2,
+            )
+            if self._is_committed(step):
+                if self._last_saved_step != step:
+                    self._commit(step)
+                return self.checkpoint_path(step)
+            return self.save(state, step=step, block=True)
 
     # -------------------------------------------------------------- driving
 

@@ -21,9 +21,10 @@ handlers actually registered here.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import signal as _signal
-from typing import Iterable
+from typing import Iterable, Iterator
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,10 +59,26 @@ HANDLED_SIGNALS: dict[str, SignalSpec] = {
 #: outrank continue signals; within a rank, latest delivery wins)
 _pending: str | None = None
 
+#: name of the signal whose emergency save is CURRENTLY in flight
+#: (bracketed by :func:`save_in_flight` from
+#: ``CheckpointManager.save_emergency``). Signal storms — schedulers
+#: re-deliver SIGTERM every few seconds until the process dies — must
+#: not re-arm the flag mid-save: the save is already running, and a
+#: re-armed flag would re-enter ``save_emergency`` at the next boundary
+#: (SIGUSR1) or leave a stale flag behind the Preempted unwind
+#: (SIGTERM). Only an ESCALATION (an exit signal landing during a
+#: continue-signal save) still latches.
+_in_flight: str | None = None
+
 
 def _handler_for(name: str):
     def _handler(signum, frame):  # noqa: ARG001 - signal handler signature
         global _pending
+        if _in_flight is not None and not (
+            HANDLED_SIGNALS[name].exits
+            and not HANDLED_SIGNALS[_in_flight].exits
+        ):
+            return  # storm re-delivery during the save: already handled
         if _pending is None or (
             HANDLED_SIGNALS[name].exits
             and not HANDLED_SIGNALS[_pending].exits
@@ -69,6 +86,31 @@ def _handler_for(name: str):
             _pending = name
     _handler.__kfac_signal__ = name  # lets tests identify our handlers
     return _handler
+
+
+@contextlib.contextmanager
+def save_in_flight(name: str) -> Iterator[None]:
+    """Mark an emergency save for ``name`` as running (handler-visible).
+
+    While active, re-deliveries of ``name`` (or anything that does not
+    escalate over it) are dropped in the handler — idempotence under
+    signal storms. Re-entrant: an escalated save nested inside a
+    continue-signal save restores the outer marker on exit. Assigning a
+    str is atomic under the GIL and handlers only read it, so no
+    masking/locking is needed.
+    """
+    global _in_flight
+    if name not in HANDLED_SIGNALS:
+        raise ValueError(
+            f'unknown preemption signal {name!r}; handled signals: '
+            f'{sorted(HANDLED_SIGNALS)}'
+        )
+    previous = _in_flight
+    _in_flight = name
+    try:
+        yield
+    finally:
+        _in_flight = previous
 
 
 class SignalHandle:
@@ -135,7 +177,13 @@ def exits(name: str) -> bool:
     return HANDLED_SIGNALS[name].exits
 
 
+def save_in_flight_signal() -> str | None:
+    """The signal whose emergency save is currently running, or None."""
+    return _in_flight
+
+
 def reset() -> None:
-    """Clear the pending flag (tests)."""
-    global _pending
+    """Clear the pending and in-flight flags (tests)."""
+    global _pending, _in_flight
     _pending = None
+    _in_flight = None

@@ -30,6 +30,33 @@ from kfac_tpu.compression import offload as offload_lib
 from kfac_tpu.layers import capture as capture_lib
 
 
+def _replicate_onto(mesh, tree: Any) -> Any:
+    """Replicate a host-resident pytree onto every device of ``mesh``.
+
+    Single-process, a plain ``device_put`` suffices. When the mesh spans
+    OS processes (multi-controller), ``device_put`` refuses shardings
+    with non-addressable devices — each process must instead construct
+    the global array from its local shards (every process holds the
+    full replicated value, e.g. extras a checkpoint restore produced
+    into a single-device template)."""
+    import numpy as np
+
+    rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    if all(
+        d.process_index == jax.process_index()
+        for d in mesh.devices.flat
+    ):
+        return jax.device_put(tree, rep)
+
+    def leaf(x):
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, rep, lambda idx: arr[idx]
+        )
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 class TrainState(NamedTuple):
     params: Any
     opt_state: Any
@@ -482,13 +509,12 @@ class Trainer:
             # (typically one device, from model.init); the engine state
             # is committed to the mesh — replicate the extras onto it so
             # the next step's jit sees one consistent device set
-            rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
             state = state._replace(
-                params=jax.device_put(state.params, rep),
-                opt_state=jax.device_put(state.opt_state, rep),
+                params=_replicate_onto(mesh, state.params),
+                opt_state=_replicate_onto(mesh, state.opt_state),
                 model_state=(
                     None if state.model_state is None
-                    else jax.device_put(state.model_state, rep)
+                    else _replicate_onto(mesh, state.model_state)
                 ),
             )
         self.resume(state)

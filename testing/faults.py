@@ -173,7 +173,9 @@ def poison_factors(
 
 
 #: supported on-disk checkpoint corruption modes
-CHECKPOINT_CORRUPTIONS = ('truncate', 'delete', 'garbage', 'metadata')
+CHECKPOINT_CORRUPTIONS = (
+    'truncate', 'delete', 'garbage', 'metadata', 'torn_latest'
+)
 
 
 def corrupt_checkpoint(path: str, mode: str = 'truncate') -> str:
@@ -196,6 +198,14 @@ def corrupt_checkpoint(path: str, mode: str = 'truncate') -> str:
       (bit rot / torn page).
     - ``'metadata'``: remove the orbax commit markers — the checkpoint no
       longer looks committed at all (crash before commit).
+    - ``'torn_latest'``: tear the rotation's ``LATEST`` pointer itself —
+      ``path`` is the ROTATION ROOT (the CheckpointManager directory),
+      not a step dir. The pointer is truncated to half and garbage bytes
+      appended, so ``latest_step()`` cannot parse it; the payload step
+      dirs stay intact and ``restore_latest`` must recover via the
+      rotation scan instead of crashing on the pointer. Distinct from
+      the payload modes: the fault is in the commit pointer, not the
+      checkpoint bytes.
 
     Returns the corrupted/removed file's path.
     """
@@ -206,6 +216,19 @@ def corrupt_checkpoint(path: str, mode: str = 'truncate') -> str:
         )
     if not os.path.isdir(path):
         raise FileNotFoundError(f'checkpoint dir {path!r} does not exist')
+    if mode == 'torn_latest':
+        victim = os.path.join(path, 'LATEST')
+        if not os.path.exists(victim):
+            raise FileNotFoundError(
+                f'no LATEST pointer under {path!r} — pass the rotation '
+                'root (the CheckpointManager directory), not a step dir'
+            )
+        size = os.path.getsize(victim)
+        with open(victim, 'r+b') as f:
+            f.truncate(size // 2)
+            f.seek(0, os.SEEK_END)
+            f.write(b'\xde\xad\xbe\xef')
+        return victim
     if mode == 'metadata':
         victim = None
         for marker in ('_CHECKPOINT_METADATA', '_METADATA'):

@@ -518,6 +518,66 @@ def test_drift_migration_rollback_on_agreement_failure(
         )
 
 
+def test_abort_storm_respects_cooldown_and_never_half_swaps(
+    tmp_path, monkeypatch
+):
+    """Chaos-harness abort storm: a pod that votes ABORT on every
+    migration round must degrade to hysteresis, not thrash — each abort
+    arms the ``cooldown_steps`` suppression window before the drift
+    detector may re-arm, the engine is never half-swapped (identity
+    stable across every abort), and the trajectory stays bit-identical
+    to an undrifted control."""
+    m, batch, params, bare, loss_fn = _setup()
+    plan = _comm_opt_plan(bare)
+    cfg = _fast_config(cooldown_steps=4)
+    trainer, mgr, ctrl = _make_fleet(
+        tmp_path / 'a', bare, loss_fn, ratio=2.0, plan=plan, config=cfg,
+    )
+    control, _, _ = _make_fleet(
+        tmp_path / 'b', bare, loss_fn, ratio=0.0, plan=plan, config=cfg,
+    )
+    old_engine = ctrl.engine
+    # every round, a peer votes the migration down
+    monkeypatch.setattr(
+        fleet_lib.multihost, 'agree_decision', lambda ok: False
+    )
+    caught: list = []
+    sa, sb, la, lb, _ = _run_paired(
+        trainer, control, params, batch, 16, caught=caught
+    )
+    aborts = [e for e in ctrl.events if e['event'] == 'migration-aborted']
+    # a storm, not a single event — and every abort left stats coherent
+    assert len(aborts) >= 2
+    assert ctrl.stats['aborts'] == len(aborts)
+    assert ctrl.stats['migrations'] == 0
+    assert [e['event'] for e in ctrl.events].count('migrated') == 0
+    # hysteresis: consecutive aborts are separated by >= cooldown_steps
+    abort_steps = [e['step'] for e in aborts]
+    assert all(
+        b - a >= cfg.cooldown_steps
+        for a, b in zip(abort_steps, abort_steps[1:])
+    ), abort_steps
+    # the warning is rate-limited per cause; at least the first abort
+    # of the storm surfaced to the operator
+    assert any(
+        isinstance(w.message, FleetWarning)
+        and 'migration-aborted' in str(w.message)
+        for w in caught
+    )
+    # never half-swapped: the SAME engine object served every step
+    assert ctrl.engine is old_engine
+    assert trainer.kfac is old_engine
+    assert mgr.engine is old_engine
+    # aborts mutate nothing: bit-identical losses and params vs control
+    np.testing.assert_allclose(la, lb, rtol=0)
+    for layer in ('fc1', 'fc2'):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(sa.params[layer]['kernel'])),
+            np.asarray(jax.device_get(sb.params[layer]['kernel'])),
+            err_msg=layer,
+        )
+
+
 def test_drift_without_periodic_saves_warns_and_stands_down(tmp_path):
     m, batch, params, bare, loss_fn = _setup()
     trainer, mgr, ctrl = _make_fleet(

@@ -162,11 +162,60 @@ def test_restore_falls_back_past_torn_checkpoint(tmp_path, mode):
         assert mgr.restore_latest().step == 1
 
 
+@pytest.mark.faults
+def test_restore_survives_torn_latest_pointer(tmp_path):
+    """A LATEST pointer torn mid-write (truncated, then trailing garbage
+    bytes — ``corrupt_checkpoint(..., 'torn_latest')``) must degrade to
+    "no pointer", not crash: ``latest_step`` returns None and
+    ``restore_latest`` still finds the newest COMMITTED step via the
+    rotation scan."""
+    m, batch, params, reg, kfac = _dense_setup()
+    mgr = CheckpointManager(
+        tmp_path, engine=kfac, install_signals=(), async_save=False, keep=3
+    )
+    state, params, _ = _run_steps(kfac, reg, m, params, batch)
+    mgr.save(state)
+    state, params, _ = _run_steps(kfac, reg, m, params, batch, state=state)
+    mgr.save(state)
+    assert mgr.latest_step() == 2
+    victim = corrupt_checkpoint(str(tmp_path), mode='torn_latest')
+    assert victim == os.path.join(str(tmp_path), 'LATEST')
+    # the torn pointer reads as garbage -> None, no UnicodeDecodeError
+    assert mgr.latest_step() is None
+    result = mgr.restore_latest()
+    assert result.step == 2
+    assert int(result.state.step) == 2
+
+
+@pytest.mark.faults
+def test_restore_walks_back_on_torn_latest_plus_torn_payload(tmp_path):
+    """The chaos harness's ``torn_checkpoint`` fault class end-to-end:
+    LATEST torn AND the newest payload truncated — the restore must walk
+    back to the newest intact rotation entry instead of crashing on
+    either corruption."""
+    m, batch, params, reg, kfac = _dense_setup()
+    mgr = CheckpointManager(
+        tmp_path, engine=kfac, install_signals=(), async_save=False, keep=3
+    )
+    state, params, _ = _run_steps(kfac, reg, m, params, batch)
+    mgr.save(state)
+    state, params, _ = _run_steps(kfac, reg, m, params, batch, state=state)
+    newest = mgr.save(state)
+    corrupt_checkpoint(str(tmp_path), mode='torn_latest')
+    corrupt_checkpoint(newest, mode='truncate')
+    with pytest.warns(CheckpointResilienceWarning, match='falling back'):
+        result = mgr.restore_latest()
+    assert result.step == 1
+    assert result.path == mgr.checkpoint_path(1)
+
+
 def test_corrupt_checkpoint_rejects_unknown_mode(tmp_path):
     with pytest.raises(ValueError, match='unknown corruption mode'):
         corrupt_checkpoint(str(tmp_path), mode='bitflip')
     with pytest.raises(FileNotFoundError):
         corrupt_checkpoint(str(tmp_path / 'nope'), mode='truncate')
+    with pytest.raises(FileNotFoundError):
+        corrupt_checkpoint(str(tmp_path), mode='torn_latest')  # no LATEST
 
 
 # ----------------------------------------------------- checkpoint.py policy
@@ -243,6 +292,76 @@ def test_signal_flag_priority_and_uninstall():
     assert signal_mod.getsignal(signal_mod.SIGUSR1) is before_usr1
     with pytest.raises(ValueError, match='SIGHUP'):
         signals.install(['SIGHUP'])
+
+
+def test_signal_storm_redelivery_during_save_is_dropped():
+    """Schedulers re-deliver SIGTERM every few seconds until the process
+    dies. A re-delivery landing while the emergency save for that same
+    signal is in flight must NOT re-arm the flag (it would re-enter
+    save_emergency at the next boundary or leave a stale flag behind the
+    Preempted unwind); an ESCALATION — SIGTERM during a SIGUSR1 save —
+    must still latch."""
+    with signals.install():
+        # storm: N stacked SIGTERMs while the SIGTERM save runs
+        with signals.save_in_flight('SIGTERM'):
+            for _ in range(3):
+                os.kill(os.getpid(), signal_mod.SIGTERM)
+            assert signals.preemption_requested() is None
+        assert signals.preemption_requested() is None  # nothing latched
+        # escalation: SIGTERM during a SIGUSR1 snapshot save latches...
+        with signals.save_in_flight('SIGUSR1'):
+            os.kill(os.getpid(), signal_mod.SIGUSR1)  # re-delivery: dropped
+            assert signals.preemption_requested() is None
+            os.kill(os.getpid(), signal_mod.SIGTERM)  # escalation: latched
+            assert signals.preemption_requested() == 'SIGTERM'
+            # ...and a SIGUSR1 cannot demote the latched EXIT priority
+            os.kill(os.getpid(), signal_mod.SIGUSR1)
+            assert signals.preemption_requested() == 'SIGTERM'
+        assert signals.consume() == 'SIGTERM'
+    with pytest.raises(ValueError, match='SIGHUP'):
+        with signals.save_in_flight('SIGHUP'):
+            pass
+    # reset() clears the in-flight marker too (crash-safety for tests)
+    with signals.save_in_flight('SIGTERM'):
+        assert signals.save_in_flight_signal() == 'SIGTERM'
+        signals.reset()
+        assert signals.save_in_flight_signal() is None
+
+
+def test_save_emergency_idempotent_under_stacked_sigterm(tmp_path):
+    """End-to-end storm idempotence: a second SIGTERM delivered WHILE
+    save_emergency('SIGTERM') is writing must not re-enter the save or
+    leave a pending flag; a SIGTERM delivered during a non-signal save
+    (fleet migration) must still latch — the preemption notice outlives
+    that save."""
+    m, batch, params, reg, kfac = _dense_setup()
+    state, _, _ = _run_steps(kfac, reg, m, params, batch)
+    with CheckpointManager(
+        tmp_path, engine=kfac, save_interval_steps=None, async_save=False
+    ) as mgr:
+        calls = []
+        real_save = mgr.save
+
+        def storming_save(state, step=None, block=True):
+            calls.append(step)
+            # the scheduler re-delivers mid-write, twice
+            os.kill(os.getpid(), signal_mod.SIGTERM)
+            os.kill(os.getpid(), signal_mod.SIGTERM)
+            return real_save(state, step=step, block=block)
+
+        mgr.save = storming_save
+        path = mgr.save_emergency(state, reason='SIGTERM')
+        assert calls == [1]
+        assert path == mgr.checkpoint_path(1)
+        # the storm was absorbed: no pending flag, nothing to re-enter
+        assert signals.preemption_requested() is None
+        # non-signal reason: a SIGTERM arriving DURING a fleet-migration
+        # save still latches — the preemption notice outlives that save
+        mgr.save = storming_save
+        mgr.save_emergency(state, reason='fleet-migration', step=2)
+        assert calls == [1, 2]
+        assert signals.preemption_requested() == 'SIGTERM'
+        signals.reset()
 
 
 def test_on_step_sigusr1_saves_and_continues(tmp_path):
