@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults async compress fleet chaos obs prof tune resilience lint lint-ir lint-pod inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults async compress fleet chaos compilewatch obs prof tune resilience lint lint-ir lint-pod inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -78,18 +78,29 @@ prof:
 	$(TEST_ENV) $(PY) -m pytest tests/test_measurement.py \
 		tests/test_calibration.py -q
 
+# compile & memory truth (docs/OBSERVABILITY.md "Compile & memory
+# truth"): recompile attribution / XLA memory accounting / mid-compile
+# heartbeat suite on both engines, plus the kfac_inspect selftest that
+# covers the "died compiling X" journal verdict
+compilewatch:
+	$(TEST_ENV) $(PY) -m pytest tests/test_compile_watch.py -q -m 'not slow'
+	$(PY) tools/kfac_inspect.py --selftest
+
 # telemetry spine: observability + flight-recorder test suites, the
 # compression/offload suite (its wire-bytes accounting is part of the
 # comms report contract), the self-driving fleet suite (its drift
 # detector consumes the flight recorder's skew columns), the
 # measurement-truth layer (prof: dispatch-free microbench, threshold
-# derivation, calibration), the unified static-analysis pass (which
+# derivation, calibration), the compile & memory truth layer
+# (compilewatch: recompile attribution, XLA memory accounting,
+# mid-compile heartbeats), the unified static-analysis pass (which
 # includes the named-scope, metric-key, plan-schema, compression-knob,
-# fleet-knob, calibration-knob, topology-knob and chaos-knob lints as
-# KFL101-KFL103/KFL105/KFL106/KFL108/KFL109/KFL111 plus the IR-tier
-# smoke pass via lint-ir), and the kfac_inspect analysis selftest
-# (see docs/OBSERVABILITY.md)
-obs: async lint compress fleet chaos prof
+# fleet-knob, calibration-knob, topology-knob, chaos-knob and
+# compile-watch-knob lints as
+# KFL101-KFL103/KFL105/KFL106/KFL108/KFL109/KFL111/KFL112 plus the
+# IR-tier smoke pass via lint-ir), and the kfac_inspect analysis
+# selftest (see docs/OBSERVABILITY.md)
+obs: async lint compress fleet chaos prof compilewatch
 	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py \
 		tests/test_flight_recorder.py -q
 	$(PY) tools/kfac_inspect.py --selftest

@@ -737,6 +737,69 @@ def _fused_kernel_probe(d: int = 256, rows: int = 512) -> dict:
     return out
 
 
+def _compile_probe(reg, run, params, data) -> dict:
+    """Compile & memory truth probe (docs/OBSERVABILITY.md "Compile &
+    memory truth").
+
+    Routes a watched ``step`` on BOTH engines through the compile watch
+    and reports: per-entry lowering/compile wall-clock and XLA-reported
+    memory (``memory_analysis``), the recompile count after warm
+    re-steps — the "jit cache stays at 1" pin as a bench headline, must
+    be 0 on both engines — and the process persistent compile-cache
+    hit/miss counters (``jax.monitoring``) as deltas over the probe, so
+    a round can tell a warm-cache start from a cold one.
+    """
+    import jax
+
+    import kfac_tpu
+    from kfac_tpu.observability import compile_watch as compile_watch_lib
+    from kfac_tpu.parallel import DistributedKFAC
+
+    counters = compile_watch_lib.persistent_cache_counters()
+    before = counters.snapshot()
+    out: dict = {'entries': {}, 'recompiles_after_warmup': {}}
+
+    (_, _), grads, stats = jax.jit(run)(params, data)
+
+    def dense():
+        return kfac_tpu.KFACPreconditioner(
+            registry=reg, compile_watch=True)
+
+    def distributed():
+        return DistributedKFAC(config=kfac_tpu.KFACPreconditioner(
+            registry=reg, compile_watch=True))
+
+    for label, build in (('dense', dense), ('distributed', distributed)):
+        engine = build()
+        step = engine.watched('step')
+        state = engine.init()
+        for _ in range(3):  # first call compiles; the rest must not
+            state, _ = step(state, grads, stats)
+        jax.block_until_ready(state)
+        watch = engine.compile_watcher()
+        out['recompiles_after_warmup'][label] = watch.recompile_count()
+        report = engine.compiled_memory_report()
+        for name, snap in report.items():
+            event = watch.events_for(name)[-1]
+            out['entries'][name] = {
+                'lowering_s': round(event['lowering_s'], 3),
+                'compile_s': round(event['compile_s'], 3),
+                'compiles': watch.compile_count(name),
+                'hbm_bytes': snap['hbm_bytes'],
+            }
+
+    after = counters.snapshot()
+    out['persistent_cache'] = {
+        'hits': (after['persistent_cache_hits']
+                 - before['persistent_cache_hits']),
+        'misses': (after['persistent_cache_misses']
+                   - before['persistent_cache_misses']),
+        'dir': after['persistent_cache_dir'],
+        'counters_installed': counters.installed,
+    }
+    return out
+
+
 def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     """Observability probe: per-step metrics JSONL, metrics-on overhead vs
     a metrics-off loop timed back-to-back, and a phase-level step-time
@@ -896,6 +959,11 @@ def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     _atomic_write(out_path, result)
     _log('  chaos probe (preemption-storm recovery SLOs, committed artifact)')
     result['chaos_probe'] = _chaos_probe()
+
+    # compile & memory truth: recompile attribution + XLA memory + cache
+    _atomic_write(out_path, result)
+    _log('  compile probe (recompile attribution + XLA memory + cache hit/miss)')
+    result['compile_probe'] = _compile_probe(reg, run, params, data)
 
 
 # ---------------------------------------------------------------------------
@@ -1444,6 +1512,11 @@ _HEADLINE_KEYS = (
     # wall-clock / fallback depth / divergence from the committed storm
     # artifact (docs/ROBUSTNESS.md "Chaos harness")
     'chaos_probe',
+    # compile & memory truth: per-entry compile wall-clock + XLA-reported
+    # HBM bytes, recompiles-after-warmup (must be 0 on both engines), and
+    # persistent compile-cache hit/miss deltas (docs/OBSERVABILITY.md
+    # "Compile & memory truth")
+    'compile_probe',
     # active tuned layout plan, when KFAC_TUNE_PLAN is set (docs/AUTOTUNE.md)
     'tuned_plan',
     # newest committed TPU evidence, replayed when the TPU probe fails

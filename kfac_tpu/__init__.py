@@ -24,6 +24,8 @@ from kfac_tpu.resilience import (
 )
 from kfac_tpu.health import HealthConfig, HealthState
 from kfac_tpu.observability import (
+    CompileWatch,
+    CompileWatchConfig,
     FlightRecorderConfig,
     MetricsCollector,
     MetricsConfig,
@@ -68,6 +70,8 @@ __all__ = [
     'DistributedStrategy',
     'FleetConfig',
     'FleetController',
+    'CompileWatch',
+    'CompileWatchConfig',
     'FlightRecorderConfig',
     'HealthConfig',
     'HealthState',

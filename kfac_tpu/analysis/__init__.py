@@ -5,7 +5,7 @@ lives at ``tools/kfaclint.py``. Importing this package populates the
 rule registry (the rule modules register on import).
 
 The AST rules (KFL001–KFL005) need only the stdlib; the drift rules
-(KFL100–KFL109) import live ``kfac_tpu`` modules at *check* time; the
+(KFL100–KFL112) import live ``kfac_tpu`` modules at *check* time; the
 IR rules (KFL201–KFL205, ``analysis/ir/``) trace the engines at *check*
 time — not at import time, so ``from kfac_tpu import analysis`` stays
 cheap; and the pod rules (KFL301–KFL305, ``analysis/pod/``) abstractly
@@ -41,7 +41,7 @@ from kfac_tpu.analysis.core import (  # noqa: F401
 AST_RULE_CODES = ('KFL001', 'KFL002', 'KFL003', 'KFL004', 'KFL005')
 PROJECT_RULE_CODES = (
     'KFL100', 'KFL101', 'KFL102', 'KFL103', 'KFL104', 'KFL105', 'KFL106',
-    'KFL107', 'KFL108', 'KFL109',
+    'KFL107', 'KFL108', 'KFL109', 'KFL110', 'KFL111', 'KFL112',
 )
 IR_RULE_CODES = ('KFL201', 'KFL202', 'KFL203', 'KFL204', 'KFL205')
 POD_RULE_CODES = ('KFL301', 'KFL302', 'KFL303', 'KFL304', 'KFL305')

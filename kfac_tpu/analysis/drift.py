@@ -1,4 +1,4 @@
-"""KFL100–KFL111: the migrated docs-vs-code drift linters.
+"""KFL100–KFL112: the migrated docs-vs-code drift linters.
 
 These are ``kind='project'`` rules — unlike the AST rules they import
 the live ``kfac_tpu`` modules and compare real objects (metric schemas,
@@ -568,6 +568,42 @@ def _chaos_knobs() -> list[core.Finding]:
     return _doc_findings('KFL111', ROBUSTNESS_DOC, line, problems)
 
 
+# ----------------------------------------------- KFL112 compile-watch knobs
+
+
+def check_compile_watch_knobs(doc_path: str = OBSERVABILITY_DOC) -> list[str]:
+    """Drift between the docs/OBSERVABILITY.md "Compile-watch knobs"
+    table and the ``CompileWatchConfig`` dataclass fields — the knobs of
+    the recompile-attribution / XLA-memory / mid-compile-heartbeat
+    watch."""
+    import dataclasses
+
+    section, _ = doc_section(doc_path, '### Compile-watch knobs')
+    documented = table_first_cells(section)
+    from kfac_tpu.observability import compile_watch as compile_watch_lib
+
+    actual = {
+        f.name
+        for f in dataclasses.fields(compile_watch_lib.CompileWatchConfig)
+    }
+    problems = []
+    for k in sorted(actual - documented):
+        problems.append(f'undocumented config field (add to {doc_path}): {k}')
+    for k in sorted(documented - actual):
+        problems.append(
+            f'documented knob is not a CompileWatchConfig field: {k}')
+    return problems
+
+
+def _compile_watch_knobs() -> list[core.Finding]:
+    try:
+        _, line = doc_section(OBSERVABILITY_DOC, '### Compile-watch knobs')
+        problems = check_compile_watch_knobs()
+    except (OSError, ValueError) as exc:
+        return _doc_findings('KFL112', OBSERVABILITY_DOC, 1, [str(exc)])
+    return _doc_findings('KFL112', OBSERVABILITY_DOC, line, problems)
+
+
 # --------------------------------------------------------------- registration
 
 
@@ -705,6 +741,20 @@ core.register(core.Rule(
         'undocumented (or phantom) storm knob means the committed SLO '
         'artifact was produced by a configuration nobody can reproduce',
     check=_chaos_knobs,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL112',
+    name='compile-watch-knobs-doc',
+    what='drift between the docs/OBSERVABILITY.md "Compile-watch knobs" '
+         'table and the CompileWatchConfig dataclass fields',
+    why='the compile watch is the truth layer for recompiles and XLA '
+        'memory, and its heartbeat journal is what a mid-compile crash '
+        'postmortem reads; an undocumented (or phantom) knob means the '
+        'crash-safety and fault-injection behavior is configured by '
+        'folklore',
+    check=_compile_watch_knobs,
     kind='project',
 ))
 

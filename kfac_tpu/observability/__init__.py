@@ -19,8 +19,13 @@ Five small modules, one per concern:
   breakdowns (the measurement-truth counterpart of host-clock phase
   timing).
 - :mod:`kfac_tpu.observability.calibration` — live comparison of
-  measured step/spike times against the autotune plan's cost model,
-  with a drift bridge into the fleet controller's retune path.
+  measured step/spike times (and XLA-reported HBM bytes) against the
+  autotune plan's cost model, with a drift bridge into the fleet
+  controller's retune path.
+- :mod:`kfac_tpu.observability.compile_watch` — recompile attribution
+  (per-entry compile events with fingerprint diffs), per-compile XLA
+  ``memory_analysis()`` accounting, and crash-safe mid-compile heartbeat
+  journaling for the engines' and Trainer's jitted entry points.
 
 See docs/OBSERVABILITY.md for the metric-key schema, flight-recorder
 sizing guidance, the postmortem bundle layout, and quickstarts.
@@ -28,6 +33,7 @@ sizing guidance, the postmortem bundle layout, and quickstarts.
 
 from kfac_tpu.observability import calibration
 from kfac_tpu.observability import comms
+from kfac_tpu.observability import compile_watch
 from kfac_tpu.observability import flight_recorder
 from kfac_tpu.observability import metrics
 from kfac_tpu.observability import profiler
@@ -39,6 +45,13 @@ from kfac_tpu.observability.calibration import (
     fleet_drift_keys,
 )
 from kfac_tpu.observability.comms import comms_summary
+from kfac_tpu.observability.compile_watch import (
+    CompileWatch,
+    CompileWatchConfig,
+    PersistentCacheCounters,
+    measured_hbm_bytes,
+    persistent_cache_counters,
+)
 from kfac_tpu.observability.flight_recorder import (
     FlightRecorderConfig,
     FlightRecorderState,
@@ -65,24 +78,30 @@ from kfac_tpu.observability.trace_attrib import (
 __all__ = [
     'CalibrationConfig',
     'CalibrationMonitor',
+    'CompileWatch',
+    'CompileWatchConfig',
     'FlightRecorderConfig',
     'FlightRecorderState',
     'JSONLWriter',
     'MetricsCollector',
     'MetricsConfig',
     'MetricsState',
+    'PersistentCacheCounters',
     'PostmortemWriter',
     'RateLimitedLogger',
     'calibration',
     'capture_steps',
     'comms',
     'comms_summary',
+    'compile_watch',
     'device_breakdown_ms',
     'drain_flight',
     'fleet_drift_keys',
     'flight_recorder',
+    'measured_hbm_bytes',
     'metric_keys',
     'metrics',
+    'persistent_cache_counters',
     'profile_session',
     'profiler',
     'sinks',

@@ -8,7 +8,10 @@ the running job checked them: a 2x-wrong model silently ships a 2x-wrong
 layout until the next offline retune.
 
 :class:`CalibrationMonitor` closes that loop. Feed it the wall-clock of
-each optimizer step (and, when you can see them, refresh-spike steps);
+each optimizer step (and, when you can see them, refresh-spike steps,
+plus XLA-reported HBM bytes via :meth:`CalibrationMonitor.observe_memory`
+/ :meth:`CalibrationMonitor.observe_memory_report` — the compile-watch
+bridge, see docs/OBSERVABILITY.md "Compile & memory truth");
 it maintains rolling residual ratios ``measured / predicted``, exposes
 them as ``calib/*`` metric keys for the JSONL / rate-limited-logger
 sinks, folds a headline ``calib/model_error`` into drained
@@ -113,6 +116,7 @@ class CalibrationMonitor:
         predicted_step_s: float,
         refresh_spike_s: float | None = None,
         config: CalibrationConfig | None = None,
+        predicted_mem_bytes: float | None = None,
     ) -> None:
         if not (predicted_step_s > 0.0):
             raise ValueError(
@@ -121,13 +125,20 @@ class CalibrationMonitor:
             # a plan with no spike prediction (sync refresh folded into
             # the step) just disables the spike channel
             refresh_spike_s = None
+        if predicted_mem_bytes is not None and predicted_mem_bytes <= 0.0:
+            # a plan with no memory prediction disables the memory channel
+            predicted_mem_bytes = None
         self.config = config or CalibrationConfig()
         self.predicted_step_s = float(predicted_step_s)
         self.refresh_spike_s = (
             None if refresh_spike_s is None else float(refresh_spike_s))
+        self.predicted_mem_bytes = (
+            None if predicted_mem_bytes is None else float(predicted_mem_bytes))
         self._steps: collections.deque[float] = collections.deque(
             maxlen=self.config.window)
         self._spikes: collections.deque[float] = collections.deque(
+            maxlen=self.config.window)
+        self._mems: collections.deque[float] = collections.deque(
             maxlen=self.config.window)
         self._seen = 0
         self._skipped = 0
@@ -142,10 +153,15 @@ class CalibrationMonitor:
 
         p = plan_lib.as_plan(plan)
         predicted = float((p.winner or {}).get('predicted_step_s', 0.0))
-        spike = _winner_row(p).get('refresh_spike_s')
+        row = _winner_row(p)
+        spike = row.get('refresh_spike_s')
+        mem = row.get('memory_per_device_bytes') or {}
+        mem_total = mem.get('total') if isinstance(mem, dict) else None
         return cls(
             predicted_step_s=predicted,
             refresh_spike_s=None if spike is None else float(spike),
+            predicted_mem_bytes=(
+                None if mem_total is None else float(mem_total)),
             config=config,
         )
 
@@ -178,6 +194,41 @@ class CalibrationMonitor:
         self._spikes.append(ratio)
         return ratio
 
+    def observe_memory(self, measured_bytes: float) -> float | None:
+        """Record an XLA-reported per-device HBM measurement (e.g. the
+        argument+output+temp bytes of the compiled step — see
+        :func:`kfac_tpu.observability.compile_watch.measured_hbm_bytes`)
+        against the plan's ``memory_per_device_bytes['total']``
+        prediction; None when the plan predicted no memory. No warmup:
+        the XLA report is deterministic per compile, not a noisy
+        wall-clock."""
+        if self.predicted_mem_bytes is None:
+            return None
+        measured_bytes = float(measured_bytes)
+        if not math.isfinite(measured_bytes) or measured_bytes <= 0.0:
+            return None
+        ratio = measured_bytes / self.predicted_mem_bytes
+        self._mems.append(ratio)
+        return ratio
+
+    def observe_memory_report(
+        self, report: dict[str, Any], entries: Sequence[str] | None = None
+    ) -> float | None:
+        """Feed an ``engine.compiled_memory_report()`` straight into the
+        memory channel: sums ``hbm_bytes`` over the report's entries
+        (optionally restricted to ``entries``) and observes the total.
+        A report with no backend memory stats is a no-op, not an error."""
+        total = 0.0
+        for name, snap in (report or {}).items():
+            if entries is not None and name not in entries:
+                continue
+            bytes_ = (snap or {}).get('hbm_bytes')
+            if bytes_:
+                total += float(bytes_)
+        if total <= 0.0:
+            return None
+        return self.observe_memory(total)
+
     # ----------------------------------------------------------- residuals
 
     @staticmethod
@@ -193,14 +244,24 @@ class CalibrationMonitor:
     def spike_ratio(self) -> float | None:
         return self._mean(self._spikes)
 
-    def model_error(self) -> float:
-        """Direction-free fold error of the step prediction: ``max(r,
-        1/r)`` of :meth:`step_ratio`; 1.0 with no evidence yet, so an
-        idle monitor never looks drifted."""
-        r = self.step_ratio()
-        if r is None or r <= 0.0:
+    def mem_ratio(self) -> float | None:
+        """Rolling mean ``measured_hbm / predicted_hbm`` (None until the
+        first memory observation)."""
+        return self._mean(self._mems)
+
+    @staticmethod
+    def _fold(ratio: float | None) -> float:
+        if ratio is None or ratio <= 0.0:
             return 1.0
-        return max(r, 1.0 / r)
+        return max(ratio, 1.0 / ratio)
+
+    def model_error(self) -> float:
+        """Direction-free fold error of the cost model: the worst of the
+        step-time and memory folds ``max(r, 1/r)``; 1.0 with no evidence
+        yet, so an idle monitor never looks drifted. A 2x-wrong memory
+        model therefore reads exactly like a 2x-wrong time model and
+        drives the same fleet drift path."""
+        return max(self._fold(self.step_ratio()), self._fold(self.mem_ratio()))
 
     # ------------------------------------------------------------ emission
 
@@ -208,23 +269,31 @@ class CalibrationMonitor:
         """Current residuals as a flat metrics record for the sinks
         (:class:`~kfac_tpu.observability.sinks.JSONLWriter` /
         ``RateLimitedLogger``). Empty until the first post-warmup
-        observation, so ``writer.write(monitor.record())`` is a safe
-        unconditional call."""
+        observation (step-time or memory — a compile-watch-only monitor
+        still emits its HBM residual), so
+        ``writer.write(monitor.record())`` is a safe unconditional
+        call."""
         r = self.step_ratio()
-        if r is None:
+        m = self.mem_ratio()
+        if r is None and m is None:
             return {}
         p = self.config.prefix
         rec = {
-            f'{p}/predicted_step_s': self.predicted_step_s,
-            f'{p}/measured_step_s': r * self.predicted_step_s,
-            f'{p}/step_ratio': r,
             f'{p}/model_error': self.model_error(),
             f'{p}/n': float(self._seen),
         }
+        if r is not None:
+            rec[f'{p}/predicted_step_s'] = self.predicted_step_s
+            rec[f'{p}/measured_step_s'] = r * self.predicted_step_s
+            rec[f'{p}/step_ratio'] = r
         s = self.spike_ratio()
         if s is not None and self.refresh_spike_s is not None:
             rec[f'{p}/predicted_spike_s'] = self.refresh_spike_s
             rec[f'{p}/spike_ratio'] = s
+        if m is not None and self.predicted_mem_bytes is not None:
+            rec[f'{p}/predicted_mem_bytes'] = self.predicted_mem_bytes
+            rec[f'{p}/measured_mem_bytes'] = m * self.predicted_mem_bytes
+            rec[f'{p}/mem_ratio'] = m
         return rec
 
     def annotate(self, record: dict[str, Any]) -> dict[str, Any]:

@@ -662,6 +662,22 @@ class PostmortemWriter:
                    fingerprint(self.engine))
         files.append('fingerprint.json')
 
+        # compile-watch truth (docs/OBSERVABILITY.md "Compile & memory
+        # truth"): the event tail attributes any recompile churn leading
+        # up to the event, and the per-entry XLA memory snapshot records
+        # what the programs actually allocate
+        watcher = getattr(self.engine, 'compile_watcher', None)
+        watch = watcher() if callable(watcher) else None
+        if watch is not None and watch.events:
+            with open(os.path.join(bdir, 'compile_events.jsonl'), 'w') as f:
+                for event in watch.events:
+                    f.write(json.dumps(event, sort_keys=True,
+                                       default=str) + '\n')
+            files.append('compile_events.jsonl')
+            _json_dump(os.path.join(bdir, 'compile_memory.json'),
+                       watch.memory_report())
+            files.append('compile_memory.json')
+
         _json_dump(os.path.join(bdir, 'MANIFEST.json'), {
             'schema': BUNDLE_SCHEMA,
             'reason': reason,

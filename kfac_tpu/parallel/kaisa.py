@@ -46,6 +46,7 @@ from kfac_tpu.compression import quant as quant_lib
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
 from kfac_tpu.observability import comms as comms_lib
+from kfac_tpu.observability import compile_watch as compile_watch_lib
 from kfac_tpu.observability import flight_recorder as flight_lib
 from kfac_tpu.observability import metrics as metrics_lib
 from kfac_tpu.ops import factors as factors_lib
@@ -1667,6 +1668,53 @@ class DistributedKFAC:
                 out['offload'], **self._offload_manager.stats
             )
         return out
+
+    def compile_watcher(
+        self,
+    ) -> 'compile_watch_lib.CompileWatch | None':
+        """This engine's :class:`~kfac_tpu.observability.compile_watch.
+        CompileWatch`, built lazily from ``config.compile_watch`` (None
+        when disabled). The Trainer's step paths count into the same
+        watch, so one report covers the whole program surface."""
+        if self.config.compile_watch is None:
+            return None
+        watch = getattr(self, '_compile_watcher', None)
+        if watch is None:
+            watch = compile_watch_lib.CompileWatch(self.config.compile_watch)
+            self._compile_watcher = watch
+        return watch
+
+    def watched(self, entry: str) -> Any:
+        """A jitted, watch-wrapped IR entry point (``'step'``,
+        ``'update_factors'``, ...). Requires ``config.compile_watch``."""
+        if entry not in self.IR_ENTRY_POINTS:
+            raise ValueError(
+                f'unknown entry {entry!r}; expected one of '
+                f'{self.IR_ENTRY_POINTS}'
+            )
+        watch = self.compile_watcher()
+        if watch is None:
+            raise ValueError(
+                'watched() requires compile_watch enabled on config'
+            )
+        cache = getattr(self, '_watched_entries', None)
+        if cache is None:
+            cache = {}
+            self._watched_entries = cache
+        if entry not in cache:
+            cache[entry] = watch.wrap(
+                f'dist_kfac.{entry}', jax.jit(getattr(self, entry))
+            )
+        return cache[entry]
+
+    def compiled_memory_report(self) -> dict[str, dict[str, Any]]:
+        """Latest XLA ``memory_analysis()`` snapshot per watched entry —
+        the measured counterpart of :meth:`memory_usage` (which estimates
+        from shard shapes) and the number autotune's
+        ``HardwareSpec.hbm_bytes`` pruning should be checked against.
+        Empty when the watch is off or the backend doesn't report."""
+        watch = self.compile_watcher()
+        return {} if watch is None else watch.memory_report()
 
     def memory_usage(self, state: DistKFACState) -> dict[str, Any]:
         """Per-device bytes by category, read from the ACTUAL shard layout.

@@ -38,6 +38,7 @@ from kfac_tpu.compression import config as compression_config_lib
 from kfac_tpu.compression import offload as offload_lib
 from kfac_tpu.layers import capture as capture_lib
 from kfac_tpu.layers import registry as registry_lib
+from kfac_tpu.observability import compile_watch as compile_watch_lib
 from kfac_tpu.observability import flight_recorder as flight_lib
 from kfac_tpu.observability import metrics as metrics_lib
 from kfac_tpu.ops import factors as factors_lib
@@ -317,6 +318,18 @@ class KFACPreconditioner:
     # min_cold_steps shorthand; or pass a compression.OffloadConfig.
     # Honored by both engines.
     offload: 'compression_config_lib.OffloadConfig | int | bool | None' = None
+    # Compile watch (kfac_tpu/observability/compile_watch.py,
+    # docs/OBSERVABILITY.md "Compile & memory truth"): recompile
+    # attribution, per-compile XLA memory accounting, and crash-safe
+    # mid-compile heartbeat journaling for every IR entry point and
+    # every Trainer step path bound to this config. None disables (zero
+    # cost, plain jit dispatch); True enables CompileWatchConfig
+    # defaults; a str is a journal_path shorthand; or pass a
+    # CompileWatchConfig. Honored by both engines; the Trainer routes
+    # its own jitted step paths through the engine's watch.
+    compile_watch: (
+        'compile_watch_lib.CompileWatchConfig | str | bool | None'
+    ) = None
 
     def __post_init__(self) -> None:
         if self.mask is not None:
@@ -359,6 +372,21 @@ class KFACPreconditioner:
             # would make it a loss-only recorder, which is never what a
             # flight=True caller wants
             self.metrics = metrics_lib.MetricsConfig()
+        if self.compile_watch is True:
+            self.compile_watch = compile_watch_lib.CompileWatchConfig()
+        elif self.compile_watch is False:
+            self.compile_watch = None
+        elif isinstance(self.compile_watch, str):
+            self.compile_watch = compile_watch_lib.CompileWatchConfig(
+                journal_path=self.compile_watch
+            )
+        elif self.compile_watch is not None and not isinstance(
+            self.compile_watch, compile_watch_lib.CompileWatchConfig
+        ):
+            raise TypeError(
+                'compile_watch must be a CompileWatchConfig, True, False, '
+                f'a journal path str, or None; got {self.compile_watch!r}'
+            )
         if self.health is True:
             self.health = health_lib.HealthConfig()
         elif self.health is False:
@@ -1143,6 +1171,54 @@ class KFACPreconditioner:
             'device_count': jax.device_count(),
             'backend': jax.default_backend(),
         }
+
+    def compile_watcher(
+        self,
+    ) -> 'compile_watch_lib.CompileWatch | None':
+        """This engine's :class:`~kfac_tpu.observability.compile_watch.
+        CompileWatch` (created lazily from ``compile_watch``; None when
+        disabled). One watch per engine instance: the Trainer's step
+        paths and :meth:`watched` entry points all count into it."""
+        if self.compile_watch is None:
+            return None
+        watch = getattr(self, '_compile_watcher', None)
+        if watch is None:
+            watch = compile_watch_lib.CompileWatch(self.compile_watch)
+            self._compile_watcher = watch
+        return watch
+
+    def watched(self, entry: str) -> Callable[..., Any]:
+        """A jitted, watch-wrapped IR entry point (``'step'``,
+        ``'update_factors'``, ...) — the observable way to drive the
+        engine directly. Requires ``compile_watch`` enabled."""
+        if entry not in self.IR_ENTRY_POINTS:
+            raise ValueError(
+                f'unknown entry {entry!r}; expected one of '
+                f'{self.IR_ENTRY_POINTS}'
+            )
+        watch = self.compile_watcher()
+        if watch is None:
+            raise ValueError(
+                'watched() requires compile_watch enabled on this config'
+            )
+        cache = getattr(self, '_watched_entries', None)
+        if cache is None:
+            cache = {}
+            self._watched_entries = cache
+        if entry not in cache:
+            cache[entry] = watch.wrap(
+                f'kfac.{entry}', jax.jit(getattr(self, entry))
+            )
+        return cache[entry]
+
+    def compiled_memory_report(self) -> dict[str, dict[str, Any]]:
+        """Latest XLA ``memory_analysis()`` snapshot per watched entry —
+        the measured counterpart of :meth:`memory_usage`'s model-side
+        estimate (see compile_watch.CompileWatch.memory_report). Empty
+        when the watch is off, nothing compiled yet, or the backend
+        doesn't report memory stats (graceful no-op)."""
+        watch = self.compile_watcher()
+        return {} if watch is None else watch.memory_report()
 
     def memory_usage(self, state: KFACState) -> dict[str, int]:
         """Approximate bytes held per category (reference:

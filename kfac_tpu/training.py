@@ -197,8 +197,14 @@ class Trainer:
             cfg = self.kfac.config if hasattr(self.kfac, 'config') else self.kfac
             self.factor_update_steps = cfg.factor_update_steps
         donate = (0,) if self.donate_state else ()
-        self._jit_with_stats = jax.jit(self._step_with_stats, donate_argnums=donate)
-        self._jit_no_stats = jax.jit(self._step_no_stats, donate_argnums=donate)
+        self._jit_with_stats = self._watched(
+            'trainer.step/with_stats',
+            jax.jit(self._step_with_stats, donate_argnums=donate),
+        )
+        self._jit_no_stats = self._watched(
+            'trainer.step/no_stats',
+            jax.jit(self._step_no_stats, donate_argnums=donate),
+        )
 
     # ------------------------------------------------------------- builders
 
@@ -223,6 +229,22 @@ class Trainer:
             return None
         cfg = self.kfac.config if hasattr(self.kfac, 'config') else self.kfac
         return getattr(cfg, 'health', None)
+
+    def _compile_watch(self):
+        """The engine's CompileWatch when ``compile_watch`` is enabled on
+        its config — the Trainer's step paths count into the engine's
+        watch, so engine.compiled_memory_report() covers both surfaces."""
+        watcher = getattr(self.kfac, 'compile_watcher', None)
+        return watcher() if callable(watcher) else None
+
+    def _watched(self, entry, fn, static_argnames=()):
+        """Route a jitted step path through the engine's compile watch
+        (see docs/OBSERVABILITY.md "Compile & memory truth"); identity
+        when the watch is off."""
+        watch = self._compile_watch()
+        if watch is None:
+            return fn
+        return watch.wrap(entry, fn, static_argnames=static_argnames)
 
     def _finish_step(self, state: TrainState, grads, stats, new_model_state,
                      loss=None) -> TrainState:
@@ -340,11 +362,13 @@ class Trainer:
             if hasattr(self, attr):
                 delattr(self, attr)
         donate = (0,) if self.donate_state else ()
-        self._jit_with_stats = jax.jit(
-            self._step_with_stats, donate_argnums=donate
+        self._jit_with_stats = self._watched(
+            'trainer.step/with_stats',
+            jax.jit(self._step_with_stats, donate_argnums=donate),
         )
-        self._jit_no_stats = jax.jit(
-            self._step_no_stats, donate_argnums=donate
+        self._jit_no_stats = self._watched(
+            'trainer.step/no_stats',
+            jax.jit(self._step_no_stats, donate_argnums=donate),
         )
         self._step_count = None  # resyncs from the next state's counter
         if self.checkpoints is not None:
@@ -658,7 +682,9 @@ class Trainer:
                     batches,
                 )
 
-            self._jit_scan = jax.jit(run, donate_argnums=donate)
+            self._jit_scan = self._watched(
+                'trainer.scan_steps', jax.jit(run, donate_argnums=donate)
+            )
         state, losses = self._jit_scan(state, batches)
         self._step_count = None  # host mirror resyncs from the device step
         self._drive_checkpoints(state)
@@ -675,12 +701,20 @@ class Trainer:
 
     def _ensure_accum_jits(self) -> None:
         if not hasattr(self, '_jit_grads_stats'):
-            self._jit_grads_stats = jax.jit(self._grads_and_stats)
-            self._jit_grads_only = jax.jit(
-                jax.value_and_grad(self.loss_fn, has_aux=True)
+            self._jit_grads_stats = self._watched(
+                'trainer.accumulate/grads_stats',
+                jax.jit(self._grads_and_stats),
             )
-            self._jit_apply_kfac = jax.jit(
-                self._apply_accumulated, static_argnames=('with_stats',)
+            self._jit_grads_only = self._watched(
+                'trainer.accumulate/grads_only',
+                jax.jit(jax.value_and_grad(self.loss_fn, has_aux=True)),
+            )
+            self._jit_apply_kfac = self._watched(
+                'trainer.accumulate/apply',
+                jax.jit(
+                    self._apply_accumulated, static_argnames=('with_stats',)
+                ),
+                static_argnames=('with_stats',),
             )
 
     # ------------------------------------------- incremental accumulation
@@ -879,8 +913,10 @@ class Trainer:
                 )
                 return new_state, loss_avg
 
-            self._jit_accum_scan = jax.jit(
-                accum, static_argnames=('with_stats',)
+            self._jit_accum_scan = self._watched(
+                'trainer.step_accumulate_scan',
+                jax.jit(accum, static_argnames=('with_stats',)),
+                static_argnames=('with_stats',),
             )
         out = self._jit_accum_scan(state, microbatches, with_stats=capture_now)
         self._step_count += 1

@@ -14,6 +14,19 @@ mkdir -p "$OUT_DIR"
 export BENCH_RUN_ID="$RUN_ID"
 export JAX_COMPILATION_CACHE_DIR="${BENCH_JAX_CACHE:-/tmp/kfac_bench_jax_cache}"
 
+# Compile-watch heartbeat journal (docs/OBSERVABILITY.md "Compile &
+# memory truth"): every watched entry writes lowering/compiling/done
+# heartbeats here with an fsync before the blocking compile, so a stage
+# the tunnel (or OOM killer) takes down MID-COMPILE still leaves a
+# record naming the entry it died in. Before spending any budget, read
+# the verdict from the previous session's leftover journal, if any.
+export KFAC_COMPILE_JOURNAL="${KFAC_COMPILE_JOURNAL:-$OUT_DIR/compile_journal.jsonl}"
+for prior in bench_runs/tpu_session2b_*/compile_journal.jsonl; do
+  [ -s "$prior" ] && [ "$prior" != "$KFAC_COMPILE_JOURNAL" ] || continue
+  echo "prior compile journal: $prior" >&2
+  timeout -k 10 60 python tools/kfac_inspect.py "$prior" >&2 || true
+done
+
 # Wait for the tunnel to recover from any prior wedge before spending
 # stage budgets: sacrificial 60s probes, up to ~20 min.
 for i in $(seq 1 20); do

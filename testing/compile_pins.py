@@ -1,0 +1,53 @@
+"""Shared no-recompile test pins on the CompileWatch counter API.
+
+Historically each suite pinned "metrics/flight/calibration add zero
+recompilations" by hand as ``jit(f)._cache_size() == 1`` — a private-API
+probe scattered across tests/test_observability.py,
+tests/test_flight_recorder.py, tests/test_calibration.py. PR 17's
+compile watch (kfac_tpu/observability/compile_watch.py,
+docs/OBSERVABILITY.md "Compile & memory truth") makes the recompile
+count a first-class runtime counter, so the pins now route through one
+helper pair:
+
+    step = compile_pins.watched_jit(kfac.step)
+    ... drive steps ...
+    compile_pins.assert_compiled_once(step)
+
+and a failing pin reports the fingerprint diff naming exactly which
+dimension/dtype/sharding forced the extra compile, instead of a bare
+cache-size integer.
+"""
+
+import jax
+
+from kfac_tpu.observability import compile_watch as compile_watch_lib
+
+
+def watched_jit(fn, entry='pin.step', static_argnames=()):
+    """``jax.jit(fn)`` routed through a fresh private CompileWatch.
+
+    Returns the :class:`~kfac_tpu.observability.compile_watch.
+    WatchedFunction`; its ``.watch`` carries the counters/events. The
+    engine's own configured watch (if any) is deliberately not reused —
+    a pin must count only the compiles the test itself drives.
+    """
+    watch = compile_watch_lib.CompileWatch(
+        compile_watch_lib.CompileWatchConfig())
+    return watch.wrap(
+        entry, jax.jit(fn, static_argnames=static_argnames or None),
+        static_argnames=static_argnames)
+
+
+def assert_compiled_once(step, entry=None):
+    """The historic ``jit(f)._cache_size() == 1`` pin: the entry
+    compiled exactly once across everything driven through ``step``.
+
+    On failure the message carries each extra compile's fingerprint
+    diff — the attribution the old cache-size assertion could not give.
+    """
+    watch = step.watch
+    n = watch.compile_count(entry)
+    assert n == 1, (
+        f'expected exactly 1 compile, saw {n}: '
+        f'{[e["diff"] for e in watch.events]}')
+    assert watch.recompile_count(entry) == 0

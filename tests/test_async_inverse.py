@@ -33,7 +33,7 @@ from kfac_tpu.async_inverse import (
 )
 from kfac_tpu.async_inverse import host as async_host
 from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
-from testing import models
+from testing import compile_pins, models
 
 WORLD = 8
 N = 4  # cadence window used throughout (factor == inverse, see docstring)
@@ -310,7 +310,7 @@ def test_inv_staleness_tracks_swap_not_schedule():
     run = kfac_tpu.CurvatureCapture(reg).value_stats_and_grad(loss_fn)
     collector = kfac_tpu.MetricsCollector()
     state = asy.init()
-    step = jax.jit(asy.step)
+    step = compile_pins.watched_jit(asy.step)
     staleness = []
     for i in range(3 * N):
         (_, _), grads, stats = run(params, (x, y))
@@ -319,6 +319,9 @@ def test_inv_staleness_tracks_swap_not_schedule():
     # cold start at 0, then a swap at every boundary
     assert staleness == [s % N for s in range(3 * N)]
     assert max(staleness) == N - 1
+    # the sliced refresh schedule is in-jit (lax.cond on the step
+    # counter): the full cadence window rides one compiled program
+    compile_pins.assert_compiled_once(step)
 
 
 # ---------------------------------------------------- quarantine interaction

@@ -119,6 +119,41 @@ def test_hbm_budget_prunes_and_exhaustion_raises():
         autotune.autotune(cfg, measure=False, hardware=tight)
 
 
+def test_hbm_pruning_consistent_with_xla_reported_memory():
+    """Cross-check the cost model's HBM pruning against XLA's own memory
+    accounting: drive the distributed step under compile-watch, read the
+    compiled program's reported temp+output bytes, and assert a budget
+    set to exactly that figure does NOT prune the layout the program
+    implements — the model's persistent-state prediction must fit inside
+    what XLA says the step actually touches."""
+    cfg, _, params, batch, loss_fn = _base(compile_watch=True)
+    mesh = kaisa_mesh(grad_worker_fraction=0.5)
+    eng = DistributedKFAC(config=cfg, mesh=mesh)
+    run = kfac_tpu.CurvatureCapture(cfg.registry).value_stats_and_grad(loss_fn)
+    (_, _), grads, stats = jax.jit(run)(params, batch)
+    state = eng.init()
+    state, _ = eng.watched('step')(state, grads, stats)
+    jax.block_until_ready(state)
+
+    snap = eng.compiled_memory_report()['dist_kfac.step']
+    mem = snap['memory']
+    assert mem is not None, 'CPU backend reports memory_analysis()'
+    temp_out = mem['temp_size_in_bytes'] + mem['output_size_in_bytes']
+    assert temp_out > 0
+
+    cand = model_lib.Candidate(grad_worker_fraction=0.5, bucket_granularity=1)
+    row = model_lib.predict(
+        cand, cfg, WORLD, model_lib.HardwareSpec(hbm_bytes=float(temp_out)))
+    # the layout the compiled program implements stays feasible under a
+    # budget of exactly the XLA-reported transient+output footprint ...
+    assert row['feasible'], row.get('infeasible_reason')
+    assert row['memory_per_device_bytes']['total'] <= temp_out
+    # ... while the same budget scaled far below the prediction prunes
+    tight = model_lib.HardwareSpec(
+        hbm_bytes=0.01 * row['memory_per_device_bytes']['total'])
+    assert not model_lib.predict(cand, cfg, WORLD, tight)['feasible']
+
+
 # ------------------------------------------------------------- plan artifact
 
 

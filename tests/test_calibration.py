@@ -40,7 +40,7 @@ from kfac_tpu.observability import flight_recorder as flight_lib
 from kfac_tpu.observability.sinks import JSONLWriter, RateLimitedLogger
 from kfac_tpu.resilience import CheckpointManager
 from kfac_tpu.warnings import reset_fleet_warnings, reset_layout_warnings
-from testing import models
+from testing import compile_pins, models
 
 FIXTURE = os.path.join(os.path.dirname(__file__), 'data', 'mini_trace')
 
@@ -478,12 +478,59 @@ def test_cost_model_drift_drives_existing_retune_path(tmp_path):
     assert ctrl_c.engine.grad_workers == WORLD
 
 
+def test_memory_residual_drives_existing_retune_path(tmp_path):
+    """PR-17 acceptance mirror of the time-residual headline: a doctored
+    2x XLA-memory residual — step timings spot-on — walks the UNMODIFIED
+    FleetController through drift -> retune -> armed -> migrated with
+    zero new controller machinery, while a fully calibrated control run
+    never re-layouts."""
+    m, batch, params, bare, loss_fn = _setup()
+    plan = _comm_opt_plan(bare)
+    ccfg = calibration.CalibrationConfig(warmup_steps=0, window=4)
+
+    drifted = calibration.CalibrationMonitor.from_plan(plan, ccfg)
+    calm = calibration.CalibrationMonitor.from_plan(plan, ccfg)
+    assert drifted.predicted_mem_bytes is not None  # plan carries memory
+    for _ in range(4):
+        # both pods time exactly as modelled; only the drifted pod's
+        # measured HBM comes back 2x the cost model's prediction
+        drifted.observe_step(drifted.predicted_step_s)
+        drifted.observe_memory(2.0 * drifted.predicted_mem_bytes)
+        calm.observe_step(calm.predicted_step_s)
+        calm.observe_memory(calm.predicted_mem_bytes)
+    assert drifted.step_ratio() == pytest.approx(1.0)
+    assert drifted.model_error() == pytest.approx(2.0)  # memory channel
+    assert calm.model_error() == pytest.approx(1.0)
+
+    trainer, ctrl = _calibrated_fleet(
+        tmp_path / 'a', bare, loss_fn, plan, drifted)
+    control, ctrl_c = _calibrated_fleet(
+        tmp_path / 'b', bare, loss_fn, plan, calm)
+    assert ctrl.engine.grad_workers == WORLD  # COMM-OPT until drift
+
+    state, cstate = trainer.init(params), control.init(params)
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        for _ in range(6):
+            state, _ = trainer.step(state, batch)
+            cstate, _ = control.step(cstate, batch)
+
+    names = [e['event'] for e in ctrl.events]
+    assert names[:4] == ['drift', 'retune', 'armed', 'migrated']
+    assert ctrl.stats['migrations'] == 1
+    assert ctrl.engine.grad_workers == 1
+    assert ctrl.engine.strategy == DistributedStrategy.MEM_OPT
+    # the calibrated pod never moves
+    assert ctrl_c.events == []
+    assert ctrl_c.engine.grad_workers == WORLD
+
+
 # ------------------------------------------------- no-recompile pinning
 
 
 def _observe_loop(kfac_like, run, params, batch, monitor, n=5):
     state = kfac_like.init()
-    step = jax.jit(kfac_like.step)
+    step = compile_pins.watched_jit(kfac_like.step)
     for _ in range(n):
         (_, _), grads, stats = run(params, batch)
         state, _ = step(state, grads, stats)
@@ -505,7 +552,7 @@ def test_calibration_is_jit_invisible_dense():
     mon = calibration.CalibrationMonitor(
         0.01, config=calibration.CalibrationConfig(warmup_steps=0))
     step = _observe_loop(kfac, run, params, (x, y), mon)
-    assert step._cache_size() == 1
+    compile_pins.assert_compiled_once(step)
     assert mon.model_error() == pytest.approx(2.0)
 
 
@@ -524,4 +571,4 @@ def test_calibration_is_jit_invisible_distributed():
     mon = calibration.CalibrationMonitor(
         0.01, config=calibration.CalibrationConfig(warmup_steps=0))
     step = _observe_loop(dk, run, params, (x, y), mon, n=3)
-    assert step._cache_size() == 1
+    compile_pins.assert_compiled_once(step)

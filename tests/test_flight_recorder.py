@@ -2,8 +2,8 @@
 
 Pins the PR's acceptance criteria: the in-jit ring records the last N
 steps on both engines (both KAISA stat transports) and via all four
-Trainer paths with ZERO added recompilations after step 1 (the
-``_cache_size() == 1`` checks mirror tests/test_observability.py),
+Trainer paths with ZERO added recompilations after step 1 (pinned via
+``testing.compile_pins``, mirroring tests/test_observability.py),
 skipped steps leave gaps rather than rows, an injected fault produces
 exactly one complete bundle per health event, and
 ``tools/kfac_inspect.py`` parses a bundle back into a correct
@@ -26,7 +26,7 @@ from kfac_tpu import tracing, training
 from kfac_tpu.observability import flight_recorder as flight_lib
 from kfac_tpu.observability import sinks
 from kfac_tpu.parallel import multihost
-from testing import faults, models
+from testing import compile_pins, faults, models
 
 sys.path.insert(
     0, os.path.join(os.path.dirname(__file__), os.pardir, 'tools')
@@ -96,11 +96,11 @@ def test_ring_records_last_n_dense():
     _, params, batch, reg, kfac, run = _dense_setup(flight=4)
     state = kfac.init()
     assert state.flight is not None and state.flight.capacity == 4
-    step = jax.jit(kfac.step)
+    step = compile_pins.watched_jit(kfac.step)
     for i in range(6):
         (_, _), grads, stats = run(params, batch)
         state, _ = step(state, grads, stats, loss=jnp.float32(10.0 + i))
-    assert step._cache_size() == 1
+    compile_pins.assert_compiled_once(step)
     recs = flight_lib.drain_flight(state)
     assert [r['step'] for r in recs] == [2, 3, 4, 5]
     assert [r['loss'] for r in recs] == [12.0, 13.0, 14.0, 15.0]
@@ -190,11 +190,11 @@ def test_ring_distributed(transport):
     cap = kfac_tpu.CurvatureCapture(reg)
     run = cap.value_stats_and_grad(models.mse_loss(m))
     state = dk.init()
-    step = jax.jit(dk.step)
+    step = compile_pins.watched_jit(dk.step)
     for i in range(5):
         (_, _), grads, stats = run(params, (x, y))
         state, _ = step(state, grads, stats, loss=jnp.float32(i))
-    assert step._cache_size() == 1
+    compile_pins.assert_compiled_once(step)
     recs = flight_lib.drain_flight(state)
     assert [r['step'] for r in recs] == [1, 2, 3, 4]
     assert [r['loss'] for r in recs] == [1.0, 2.0, 3.0, 4.0]

@@ -22,7 +22,7 @@ from kfac_tpu.observability import metrics as metrics_lib
 from kfac_tpu.observability import profiler as profiler_lib
 from kfac_tpu.observability import sinks
 from kfac_tpu.parallel import collectives
-from testing import models
+from testing import compile_pins, models
 
 
 def _dense_setup(**cfg_kw):
@@ -38,7 +38,7 @@ def _dense_setup(**cfg_kw):
 
 def _run_steps(kfac, run, params, batch, n):
     state = kfac.init()
-    step = jax.jit(kfac.step)
+    step = compile_pins.watched_jit(kfac.step)
     for _ in range(n):
         (_, _), grads, stats = run(params, batch)
         state, _ = step(state, grads, stats)
@@ -83,10 +83,10 @@ def test_metrics_disabled_state_and_drain_noop():
 
 
 def test_metrics_no_recompilation_across_steps():
-    """The static key schema keeps the jit cache at one entry."""
+    """The static key schema compiles the step exactly once."""
     _, params, batch, _, kfac, run = _dense_setup(metrics=True)
     _, step = _run_steps(kfac, run, params, batch, 5)
-    assert step._cache_size() == 1
+    compile_pins.assert_compiled_once(step)
 
 
 def test_staleness_tracks_update_cadence():
@@ -202,11 +202,11 @@ def test_metric_schema_distributed(transport):
     cap = kfac_tpu.CurvatureCapture(reg)
     run = cap.value_stats_and_grad(models.mse_loss(m))
     state = dk.init()
-    step = jax.jit(dk.step)
+    step = compile_pins.watched_jit(dk.step)
     for _ in range(2):
         (_, _), grads, stats = run(params, (x, y))
         state, _ = step(state, grads, stats)
-    assert step._cache_size() == 1
+    compile_pins.assert_compiled_once(step)
     rec = kfac_tpu.MetricsCollector(include_health=False).drain(state)
     expected = set(
         metrics_lib.metric_keys(cfg.metrics, list(reg.layers))

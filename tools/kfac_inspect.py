@@ -14,7 +14,17 @@ first, and when* — the step each layer's factor bounds first blew up or
 went non-finite, when damping escalated, when the KL clip started biting,
 where skip-step gaps appear in the recorded step sequence, and the first
 non-finite loss. For bundles it also summarizes the trigger, health
-counters, topology fingerprint, and the comms/padding report.
+counters, topology fingerprint, the comms/padding report, and the
+compile-watch event tail (compile counts, recompiles, XLA memory).
+
+A third input kind is the compile-watch heartbeat journal
+(``CompileWatchConfig.journal_path`` — ``phase: lowering -> compiling ->
+done`` records, fsynced before each blocking phase). A journal whose
+last heartbeat for some entry never reached ``done`` yields the
+"died compiling X" verdict: the entry name, the phase it died in, and
+the elapsed time the journal proves — the mid-compile postmortem the
+live-tunnel sessions were missing (ROADMAP item 1). Mixed files work:
+compile records and metric records are partitioned and each analyzed.
 
 Deliberately dependency-free (stdlib only — no jax, no numpy): bundles
 are meant to be inspected on any machine, including ones without the
@@ -81,12 +91,31 @@ def load_bundle(bdir: str) -> dict[str, Any]:
         bundle['manifest'] = json.load(f)
     hist = os.path.join(bdir, 'history.jsonl')
     bundle['history'] = load_jsonl(hist) if os.path.exists(hist) else []
-    for name in ('health', 'comms', 'fingerprint', 'factors'):
+    events = os.path.join(bdir, 'compile_events.jsonl')
+    bundle['compile_events'] = (
+        load_jsonl(events) if os.path.exists(events) else [])
+    for name in ('health', 'comms', 'fingerprint', 'factors',
+                 'compile_memory'):
         path = os.path.join(bdir, f'{name}.json')
         if os.path.exists(path):
             with open(path) as f:
                 bundle[name] = json.load(f)
     return bundle
+
+
+def split_compile_records(
+    records: list[dict[str, Any]],
+) -> tuple[list[dict[str, Any]], list[dict[str, Any]]]:
+    """Partition a JSONL into (compile heartbeats, metric records) so a
+    compile-watch journal — or a mixed log — routes to both analyses."""
+    compile_recs: list[dict[str, Any]] = []
+    metric_recs: list[dict[str, Any]] = []
+    for r in records:
+        if r.get('kind') == 'compile' and 'phase' in r:
+            compile_recs.append(r)
+        else:
+            metric_recs.append(r)
+    return compile_recs, metric_recs
 
 
 # ---------------------------------------------------------------- analysis
@@ -175,6 +204,75 @@ def analyze(records: list[dict[str, Any]]) -> dict[str, Any]:
     }
 
 
+def analyze_compile_journal(
+    records: list[dict[str, Any]],
+) -> dict[str, Any]:
+    """Triage a compile-watch heartbeat journal.
+
+    Each compilation journals ``lowering -> compiling -> done`` records
+    (fsynced before the blocking phase they announce), so the last
+    heartbeat of a killed process is trustworthy. Returns::
+
+        {'entries': {entry: {'compiles': N, 'total_compile_s': S}},
+         'in_flight': [{'entry', 'phase', 'elapsed_s', ...}...],
+         'verdict': 'died compiling ...' | None}
+
+    ``in_flight`` lists compilations that never reached ``done`` —
+    normally empty; after a mid-compile death it names the culprit.
+    """
+    entries: dict[str, dict[str, Any]] = {}
+    open_compiles: dict[tuple[Any, Any, Any], dict[str, Any]] = {}
+    for rec in records:
+        phase = rec.get('phase')
+        entry = rec.get('entry')
+        key = (rec.get('pid'), entry, rec.get('n'))
+        if phase == 'lowering':
+            fp = rec.get('fingerprint') or {}
+            open_compiles[key] = {
+                'entry': entry,
+                'phase': 'lowering',
+                'started_t': rec.get('t'),
+                'last_t': rec.get('t'),
+                'pid': rec.get('pid'),
+                'n_args': len(fp),
+                'diff': rec.get('diff') or [],
+            }
+        elif key in open_compiles:
+            oc = open_compiles[key]
+            oc['last_t'] = rec.get('t', oc['last_t'])
+            if phase == 'done':
+                agg = entries.setdefault(
+                    entry, {'compiles': 0, 'total_compile_s': 0.0})
+                agg['compiles'] += 1
+                agg['total_compile_s'] += float(rec.get('compile_s') or 0.0)
+                del open_compiles[key]
+            else:
+                oc['phase'] = phase
+                if rec.get('lowering_s') is not None:
+                    oc['lowering_s'] = rec['lowering_s']
+
+    in_flight = []
+    for oc in open_compiles.values():
+        started, last = oc.get('started_t'), oc.get('last_t')
+        if isinstance(started, (int, float)) and isinstance(
+                last, (int, float)):
+            oc['elapsed_s'] = max(0.0, float(last) - float(started))
+        in_flight.append(oc)
+
+    verdict = None
+    if in_flight:
+        worst = in_flight[-1]  # journal order: the last one written
+        elapsed = worst.get('elapsed_s')
+        after = (f' after >= {elapsed:.1f}s'
+                 if isinstance(elapsed, float) else '')
+        verdict = (
+            f"died compiling {worst['entry']!r}{after}: last heartbeat "
+            f"in phase {worst['phase']!r} never reached 'done' "
+            f"({worst.get('n_args', '?')} fingerprinted arg leaves, "
+            f"pid {worst.get('pid', '?')})")
+    return {'entries': entries, 'in_flight': in_flight, 'verdict': verdict}
+
+
 # ---------------------------------------------------------------- printing
 
 
@@ -197,6 +295,43 @@ def _print_analysis(analysis: dict[str, Any]) -> None:
               f"step {fb['step']} ({fb['detail']})")
     else:
         print('first bad layer: none (no per-layer factor/damping events)')
+
+
+def _print_compile_analysis(comp: dict[str, Any]) -> None:
+    entries = comp['entries']
+    total = sum(e['compiles'] for e in entries.values())
+    print(f"compile journal: {total} completed compilation(s) across "
+          f"{len(entries)} entry(ies)")
+    for name, agg in sorted(entries.items()):
+        print(f"  {name}: {agg['compiles']} compile(s), "
+              f"{agg['total_compile_s']:.2f}s total")
+    if comp['verdict']:
+        print(f"VERDICT: {comp['verdict']}")
+    else:
+        print('no in-flight compilations: every heartbeat reached done')
+
+
+def _print_compile_events(bundle: dict[str, Any]) -> None:
+    events = bundle.get('compile_events') or []
+    if not events:
+        return
+    counts: dict[str, int] = {}
+    for ev in events:
+        counts[ev.get('entry', '?')] = counts.get(ev.get('entry', '?'), 0) + 1
+    recompiles = sum(c - 1 for c in counts.values() if c > 1)
+    print(f"  compile events: {len(events)} compile(s) over "
+          f"{len(counts)} entry(ies), {recompiles} recompile(s)")
+    last = events[-1]
+    diff = last.get('diff') or []
+    if diff:
+        print(f"    last recompile ({last.get('entry')}): {diff[0]}" +
+              (f' (+{len(diff) - 1} more)' if len(diff) > 1 else ''))
+    memory = bundle.get('compile_memory') or {}
+    for name, snap in sorted(memory.items()):
+        hbm = snap.get('hbm_bytes')
+        if hbm:
+            print(f"    {name}: XLA memory {hbm / 1e6:.2f} MB "
+                  f"(arg+out+temp)")
 
 
 def _print_bundle_header(bundle: dict[str, Any]) -> None:
@@ -234,6 +369,7 @@ def _print_bundle_header(bundle: dict[str, Any]) -> None:
               f"{st.get('bytes', '?')} B, grad broadcast "
               f"{comms.get('grad_broadcast_bytes', '?')} B, padding fill "
               f"{totals.get('fill', '?')}")
+    _print_compile_events(bundle)
 
 
 # ---------------------------------------------------------------- selftest
@@ -280,6 +416,41 @@ def selftest() -> int:
                      for s in range(4)])
     assert clean['events'] == [] and clean['first_bad_layer'] is None
 
+    # compile journal: a completed compile plus one killed mid-compile
+    # (lowering + compiling heartbeats, never done) yields the verdict
+    journal = [
+        {'kind': 'compile', 'phase': 'lowering', 'entry': 'kfac.step',
+         'n': 1, 'pid': 41, 't': 100.0,
+         'fingerprint': {'[0]': {'shape': [8, 8], 'dtype': 'float32'}}},
+        {'kind': 'compile', 'phase': 'compiling', 'entry': 'kfac.step',
+         'n': 1, 'pid': 41, 't': 100.5, 'lowering_s': 0.5},
+        {'kind': 'compile', 'phase': 'done', 'entry': 'kfac.step',
+         'n': 1, 'pid': 41, 't': 103.0, 'compile_s': 2.5},
+        {'kind': 'compile', 'phase': 'lowering', 'entry': 'trainer.step',
+         'n': 1, 'pid': 41, 't': 110.0,
+         'fingerprint': {'[0]': {'shape': [64, 6], 'dtype': 'float32'},
+                         '[1]': {'shape': [64, 4], 'dtype': 'float32'}}},
+        {'kind': 'compile', 'phase': 'compiling', 'entry': 'trainer.step',
+         'n': 1, 'pid': 41, 't': 112.0, 'lowering_s': 2.0},
+        # SIGKILL here: no 'done' for trainer.step
+    ]
+    comp = analyze_compile_journal(journal)
+    assert comp['entries'] == {
+        'kfac.step': {'compiles': 1, 'total_compile_s': 2.5}}, comp
+    assert len(comp['in_flight']) == 1, comp
+    flight = comp['in_flight'][0]
+    assert flight['entry'] == 'trainer.step', flight
+    assert flight['phase'] == 'compiling', flight
+    assert flight['elapsed_s'] == 2.0, flight
+    assert comp['verdict'] and 'trainer.step' in comp['verdict']
+    assert "'compiling'" in comp['verdict']
+    # a clean journal (every compile reached done) has no verdict
+    clean_comp = analyze_compile_journal(journal[:3])
+    assert clean_comp['verdict'] is None and not clean_comp['in_flight']
+    # the partitioner routes mixed files to both analyses
+    c_recs, m_recs = split_compile_records(journal + records)
+    assert len(c_recs) == len(journal) and len(m_recs) == len(records)
+
     # bundle round-trip on a synthesized minimal bundle
     import tempfile
     with tempfile.TemporaryDirectory() as tmp:
@@ -288,14 +459,23 @@ def selftest() -> int:
         with open(os.path.join(bdir, 'MANIFEST.json'), 'w') as f:
             json.dump({'schema': 1, 'reason': 'nonfinite', 'step': 7,
                        'process_index': 0, 'record': {},
-                       'files': ['history.jsonl']}, f)
+                       'files': ['history.jsonl',
+                                 'compile_events.jsonl']}, f)
         with open(os.path.join(bdir, 'history.jsonl'), 'w') as f:
             for rec in records:
                 f.write(json.dumps(rec) + '\n')
+        with open(os.path.join(bdir, 'compile_events.jsonl'), 'w') as f:
+            f.write(json.dumps({
+                'entry': 'kfac.step', 'n': 2, 'compile_s': 1.5,
+                'diff': ['[0][0]: dim 0 32 -> 64'],
+                'memory': {'argument_size_in_bytes': 1024}}) + '\n')
         bundle = load_bundle(bdir)
         a2 = analyze(bundle['history'])
         assert a2['first_bad_layer']['layer'] == 'fc2'
         assert bundle['manifest']['reason'] == 'nonfinite'
+        assert bundle['compile_events'][0]['entry'] == 'kfac.step'
+        assert bundle['compile_events'][0]['diff'] == [
+            '[0][0]: dim 0 32 -> 64']
     print('kfac_inspect selftest ok')
     return 0
 
@@ -330,9 +510,14 @@ def main(argv: list[str] | None = None) -> int:
     else:
         records = load_jsonl(args.path)
 
-    analysis = analyze(records)
+    compile_recs, metric_recs = split_compile_records(records)
+    compile_analysis = (
+        analyze_compile_journal(compile_recs) if compile_recs else None)
+    analysis = analyze(metric_recs)
     if args.json:
         out = dict(analysis)
+        if compile_analysis is not None:
+            out['compile'] = compile_analysis
         if bundle is not None:
             out['manifest'] = bundle['manifest']
         json.dump(out, sys.stdout, indent=2)
@@ -340,7 +525,10 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if bundle is not None:
         _print_bundle_header(bundle)
-    _print_analysis(analysis)
+    if compile_analysis is not None:
+        _print_compile_analysis(compile_analysis)
+    if metric_recs or compile_analysis is None:
+        _print_analysis(analysis)
     return 0
 
 
