@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults async compress fleet chaos compilewatch obs prof tune resilience lint lint-ir lint-pod inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults async compress fleet chaos compilewatch ledger obs prof tune resilience lint lint-ir lint-pod inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -86,6 +86,16 @@ compilewatch:
 	$(TEST_ENV) $(PY) -m pytest tests/test_compile_watch.py -q -m 'not slow'
 	$(PY) tools/kfac_inspect.py --selftest
 
+# unified run ledger: adapter/correlation/sentinel suite, the
+# kfac_ledger CLI selftest, and the committed-fixture timeline +
+# sentinel runs (byte-stable golden, provenance-matched check)
+ledger:
+	$(TEST_ENV) $(PY) -m pytest tests/test_ledger.py -q -m 'not slow'
+	$(PY) tools/kfac_ledger.py --selftest
+	$(PY) tools/kfac_ledger.py --timeline tests/data/mini_ledger >/dev/null
+	$(PY) tools/kfac_ledger.py --check tests/data/mini_ledger/bench_round.json \
+		--baseline tests/data/mini_ledger/LEDGER.json
+
 # telemetry spine: observability + flight-recorder test suites, the
 # compression/offload suite (its wire-bytes accounting is part of the
 # comms report contract), the self-driving fleet suite (its drift
@@ -98,9 +108,10 @@ compilewatch:
 # fleet-knob, calibration-knob, topology-knob, chaos-knob and
 # compile-watch-knob lints as
 # KFL101-KFL103/KFL105/KFL106/KFL108/KFL109/KFL111/KFL112 plus the
-# IR-tier smoke pass via lint-ir), and the kfac_inspect analysis
-# selftest (see docs/OBSERVABILITY.md)
-obs: async lint compress fleet chaos prof compilewatch
+# IR-tier smoke pass via lint-ir), the unified run ledger (ledger:
+# adapters, correlation timeline, perf-regression sentinel, KFL113),
+# and the kfac_inspect analysis selftest (see docs/OBSERVABILITY.md)
+obs: async lint compress fleet chaos prof compilewatch ledger
 	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py \
 		tests/test_flight_recorder.py -q
 	$(PY) tools/kfac_inspect.py --selftest

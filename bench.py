@@ -625,6 +625,42 @@ def _chaos_probe() -> dict:
     }
 
 
+def _ledger_probe(result: dict) -> dict:
+    """Perf-regression sentinel verdict (docs/OBSERVABILITY.md "Run
+    ledger"): this round's headline keys vs the committed baseline
+    ``bench_runs/LEDGER.json``, per-key ok/regressed/missing plus a
+    top-level status. Provenance-aware — a CPU-fallback round is never
+    compared against TPU medians (status ``refused``), and a missing
+    baseline is ``no_baseline``, not a failure. Read-only and advisory
+    inside the round: CI gates on ``tools/kfac_ledger.py --check``,
+    whose exit code carries the same verdict.
+    """
+    try:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            'kfac_tpu', 'observability', 'ledger.py')
+        spec = importlib.util.spec_from_file_location('_kfac_ledger', path)
+        assert spec is not None and spec.loader is not None
+        ledger = importlib.util.module_from_spec(spec)
+        sys.modules['_kfac_ledger'] = ledger
+        spec.loader.exec_module(ledger)
+        baseline_path = os.path.join(
+            os.environ.get('BENCH_RUNS_DIR', 'bench_runs'), 'LEDGER.json')
+        baseline = (ledger.load_baseline(baseline_path)
+                    if os.path.exists(baseline_path) else None)
+        verdict = ledger.sentinel_check(result, baseline)
+        return {
+            'status': verdict['status'],
+            'regressed_keys': verdict['regressed_keys'],
+            'baseline_platform': verdict['baseline_platform'],
+            'keys': {k: v['verdict'] for k, v in verdict['keys'].items()},
+        }
+    except Exception as exc:  # never kill the round over the sentinel
+        return {'status': 'error', 'error': f'{type(exc).__name__}: {exc}'}
+
+
 def _fused_kernel_probe(d: int = 256, rows: int = 512) -> dict:
     """Within-run A/B of the fused step-path kernels vs their unfused
     XLA expressions (docs/ARCHITECTURE.md "Fused step-path kernels").
@@ -1517,6 +1553,10 @@ _HEADLINE_KEYS = (
     # persistent compile-cache hit/miss deltas (docs/OBSERVABILITY.md
     # "Compile & memory truth")
     'compile_probe',
+    # perf-regression sentinel verdict: this round's headline keys vs the
+    # committed provenance-aware baseline bench_runs/LEDGER.json
+    # (docs/OBSERVABILITY.md "Run ledger")
+    'ledger_probe',
     # active tuned layout plan, when KFAC_TUNE_PLAN is set (docs/AUTOTUNE.md)
     'tuned_plan',
     # newest committed TPU evidence, replayed when the TPU probe fails
@@ -1630,6 +1670,7 @@ def _orchestrate(result: dict) -> None:
                 result[k] = stage[k]
         _persist(result)
         acc_stage(env)
+        result['ledger_probe'] = _ledger_probe(result)
         _persist(result, partial=not stage.get('ok', False))
         return
 
@@ -1759,6 +1800,7 @@ def _orchestrate(result: dict) -> None:
             'kfac_images_per_sec'
         )
     acc_stage({**cache_env})
+    result['ledger_probe'] = _ledger_probe(result)
     done = stages.get(result.get('headline_stage', ''), {}).get('status')
     _persist(result, partial=done != 'ok')
 

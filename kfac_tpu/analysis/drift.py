@@ -1,4 +1,4 @@
-"""KFL100–KFL112: the migrated docs-vs-code drift linters.
+"""KFL100–KFL113: the migrated docs-vs-code drift linters.
 
 These are ``kind='project'`` rules — unlike the AST rules they import
 the live ``kfac_tpu`` modules and compare real objects (metric schemas,
@@ -604,6 +604,55 @@ def _compile_watch_knobs() -> list[core.Finding]:
     return _doc_findings('KFL112', OBSERVABILITY_DOC, line, problems)
 
 
+# --------------------------------------------------- KFL113 run-ledger tables
+
+
+def check_ledger_tables(doc_path: str = OBSERVABILITY_DOC) -> list[str]:
+    """Drift between the docs/OBSERVABILITY.md "Run ledger" chapter and
+    the ledger module: the "Ledger knobs" table vs the ``LedgerConfig``
+    dataclass fields, the "Stream adapters" matrix vs the ``ADAPTERS``
+    registry, the "Correlation rules" table vs ``DEFAULT_RULES``, and
+    the "Sentinel tolerances" table vs ``DEFAULT_SENTINEL_KEYS``."""
+    import dataclasses
+
+    from kfac_tpu.observability import ledger as ledger_lib
+
+    pinned: list[tuple[str, set[str], str]] = [
+        ('### Ledger knobs',
+         {f.name for f in dataclasses.fields(ledger_lib.LedgerConfig)},
+         'LedgerConfig field'),
+        ('### Stream adapters',
+         set(ledger_lib.ADAPTERS),
+         'ADAPTERS stream'),
+        ('### Correlation rules',
+         {r.name for r in ledger_lib.DEFAULT_RULES},
+         'DEFAULT_RULES rule'),
+        ('### Sentinel tolerances',
+         set(ledger_lib.DEFAULT_SENTINEL_KEYS),
+         'DEFAULT_SENTINEL_KEYS key'),
+    ]
+    problems = []
+    for heading, actual, what in pinned:
+        section, _ = doc_section(doc_path, heading)
+        documented = table_first_cells(section)
+        for k in sorted(actual - documented):
+            problems.append(
+                f'undocumented {what} (add to {doc_path} "{heading}"): {k}')
+        for k in sorted(documented - actual):
+            problems.append(
+                f'documented entry in "{heading}" is not a {what}: {k}')
+    return problems
+
+
+def _ledger_tables() -> list[core.Finding]:
+    try:
+        _, line = doc_section(OBSERVABILITY_DOC, '## Run ledger')
+        problems = check_ledger_tables()
+    except (OSError, ValueError) as exc:
+        return _doc_findings('KFL113', OBSERVABILITY_DOC, 1, [str(exc)])
+    return _doc_findings('KFL113', OBSERVABILITY_DOC, line, problems)
+
+
 # --------------------------------------------------------------- registration
 
 
@@ -755,6 +804,21 @@ core.register(core.Rule(
         'crash-safety and fault-injection behavior is configured by '
         'folklore',
     check=_compile_watch_knobs,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL113',
+    name='run-ledger-doc',
+    what='drift between the docs/OBSERVABILITY.md "Run ledger" chapter '
+         '(knob / stream-adapter / correlation-rule / sentinel-tolerance '
+         'tables) and the ledger module (LedgerConfig, ADAPTERS, '
+         'DEFAULT_RULES, DEFAULT_SENTINEL_KEYS)',
+    why='the ledger is the cross-stream triage entry point and the bench '
+        'regression gate; an undocumented adapter or rule means operators '
+        'triage against tables that lie, and a phantom sentinel key means '
+        'CI enforces a tolerance nobody can look up',
+    check=_ledger_tables,
     kind='project',
 ))
 

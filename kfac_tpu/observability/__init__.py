@@ -26,6 +26,12 @@ Five small modules, one per concern:
   (per-entry compile events with fingerprint diffs), per-compile XLA
   ``memory_analysis()`` accounting, and crash-safe mid-compile heartbeat
   journaling for the engines' and Trainer's jitted entry points.
+- :mod:`kfac_tpu.observability.ledger` — the unified run ledger:
+  per-stream adapters normalizing every telemetry stream into one event
+  schema keyed by ``(run_id, stream, step, wall_clock)``, a declarative
+  correlation engine joining anomalies across streams into causal
+  timeline annotations, and the provenance-aware bench perf-regression
+  sentinel (``bench_runs/LEDGER.json``).
 
 See docs/OBSERVABILITY.md for the metric-key schema, flight-recorder
 sizing guidance, the postmortem bundle layout, and quickstarts.
@@ -35,6 +41,7 @@ from kfac_tpu.observability import calibration
 from kfac_tpu.observability import comms
 from kfac_tpu.observability import compile_watch
 from kfac_tpu.observability import flight_recorder
+from kfac_tpu.observability import ledger
 from kfac_tpu.observability import metrics
 from kfac_tpu.observability import profiler
 from kfac_tpu.observability import sinks
@@ -58,6 +65,16 @@ from kfac_tpu.observability.flight_recorder import (
     PostmortemWriter,
     drain_flight,
 )
+from kfac_tpu.observability.ledger import (
+    CorrelationRule,
+    LedgerConfig,
+    RunLedger,
+    build_baseline,
+    new_run_id,
+    render_timeline,
+    run_header,
+    sentinel_check,
+)
 from kfac_tpu.observability.metrics import (
     MetricsCollector,
     MetricsConfig,
@@ -80,15 +97,19 @@ __all__ = [
     'CalibrationMonitor',
     'CompileWatch',
     'CompileWatchConfig',
+    'CorrelationRule',
     'FlightRecorderConfig',
     'FlightRecorderState',
     'JSONLWriter',
+    'LedgerConfig',
     'MetricsCollector',
     'MetricsConfig',
     'MetricsState',
     'PersistentCacheCounters',
     'PostmortemWriter',
     'RateLimitedLogger',
+    'RunLedger',
+    'build_baseline',
     'calibration',
     'capture_steps',
     'comms',
@@ -98,12 +119,17 @@ __all__ = [
     'drain_flight',
     'fleet_drift_keys',
     'flight_recorder',
+    'ledger',
     'measured_hbm_bytes',
     'metric_keys',
     'metrics',
+    'new_run_id',
     'persistent_cache_counters',
     'profile_session',
     'profiler',
+    'render_timeline',
+    'run_header',
+    'sentinel_check',
     'sinks',
     'step_annotation',
     'step_attribution',

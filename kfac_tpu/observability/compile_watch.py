@@ -376,6 +376,11 @@ class CompileWatch:
     def __init__(self, config: CompileWatchConfig | None = None) -> None:
         self.config = config or CompileWatchConfig()
         self.events: list[dict[str, Any]] = []
+        # optional shared run identifier (ledger.new_run_id(), threaded
+        # in by Trainer): stamped into journal records and events so the
+        # compile stream self-identifies to the run ledger. An attribute
+        # rather than a config field: it is per-run state, not a knob.
+        self.run_id: str | None = None
         self._counts: dict[str, int] = {}
         self._last_fp: dict[str, dict[str, Any]] = {}
         self._lock = threading.Lock()
@@ -439,6 +444,8 @@ class CompileWatch:
         record = dict(record)
         record.setdefault('kind', 'compile')
         record.setdefault('pid', os.getpid())
+        if self.run_id is not None:
+            record.setdefault('run_id', self.run_id)
         line = json.dumps(record, sort_keys=True, default=str)
         with self._lock:
             with open(path, 'a', encoding='utf-8') as f:
@@ -449,6 +456,8 @@ class CompileWatch:
 
     def _record_event(self, event: dict[str, Any]) -> None:
         with self._lock:
+            if self.run_id is not None:
+                event.setdefault('run_id', self.run_id)
             entry = event['entry']
             self._counts[entry] = self._counts.get(entry, 0) + 1
             event['n'] = self._counts[entry]

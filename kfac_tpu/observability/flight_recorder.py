@@ -463,6 +463,7 @@ class PostmortemWriter:
         max_bundles: int = 16,
         all_processes: bool = False,
         checkpoint_manager: Any = None,
+        run_id: str | None = None,
     ) -> None:
         self.root = os.fspath(root)
         os.makedirs(self.root, exist_ok=True)
@@ -470,6 +471,9 @@ class PostmortemWriter:
         self.collector = collector or metrics_lib.MetricsCollector()
         self.max_bundles = int(max_bundles)
         self.all_processes = bool(all_processes)
+        # optional shared run identifier (ledger.new_run_id()): stamped
+        # into MANIFEST.json so bundles join the run ledger's streams
+        self.run_id = run_id
         # a resilience.CheckpointManager: a degrade event additionally
         # flushes ONE emergency blocking checkpoint (the state that
         # diverged, preserved for offline replay next to the bundle)
@@ -680,6 +684,7 @@ class PostmortemWriter:
 
         _json_dump(os.path.join(bdir, 'MANIFEST.json'), {
             'schema': BUNDLE_SCHEMA,
+            'run_id': self.run_id,
             'reason': reason,
             'step': step,
             'process_index': jax.process_index(),

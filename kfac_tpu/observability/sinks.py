@@ -41,6 +41,14 @@ class JSONLWriter:
     so no single record is ever split across files and the active file
     always holds the newest records. Rotation is off by default —
     behavior is unchanged for existing callers.
+
+    ``run_header`` (the shared run-header from ``ledger.run_header()``,
+    a ``{'kind': 'run_header', 'run_id', 'stream', 'schema'}`` mapping)
+    is stamped once as the first record of a new or empty file — and of
+    each rotated successor — so every stream from one run
+    self-identifies to the run ledger. Appending to a file that already
+    has records never duplicates the header; header-less files stay
+    valid (``run_id=None`` on ingest).
     """
 
     def __init__(
@@ -49,6 +57,7 @@ class JSONLWriter:
         append: bool = True,
         max_bytes: int = 0,
         max_files: int = 3,
+        run_header: dict[str, Any] | None = None,
     ):
         if max_bytes < 0:
             raise ValueError(f'max_bytes must be >= 0, got {max_bytes}')
@@ -63,7 +72,10 @@ class JSONLWriter:
         parent = os.path.dirname(self.path)
         if parent:
             os.makedirs(parent, exist_ok=True)
+        self.run_header = dict(run_header) if run_header else None
         self._file: IO[str] | None = open(self.path, 'a' if append else 'w')
+        if self.run_header and self._file.tell() == 0:
+            self.write(self.run_header)
 
     def _rotate(self) -> None:
         assert self._file is not None
@@ -78,6 +90,10 @@ class JSONLWriter:
                 os.replace(src, f'{self.path}.{n + 1}')
         os.replace(self.path, f'{self.path}.1')
         self._file = open(self.path, 'w')
+        if self.run_header:
+            self._file.write(json.dumps(
+                self.run_header, default=_json_default, sort_keys=True)
+                + '\n')
 
     def write(self, record: dict[str, Any]) -> None:
         if not record:

@@ -28,6 +28,7 @@ from kfac_tpu import tracing
 from kfac_tpu.async_inverse import host as async_host_lib
 from kfac_tpu.compression import offload as offload_lib
 from kfac_tpu.layers import capture as capture_lib
+from kfac_tpu.observability import ledger as ledger_lib
 
 
 def _replicate_onto(mesh, tree: Any) -> Any:
@@ -107,6 +108,12 @@ class Trainer:
             over the ``checkpoints`` slot with its own manager, drives
             drift checks/migrations from every step path, and serves
             :meth:`restore_latest` elastically.
+        run_id: shared run identifier threaded into every telemetry
+            stream this Trainer touches (the engine's compile-watch
+            journal stamps it per record; :meth:`run_header` builds the
+            header for ``JSONLWriter``/``PostmortemWriter``), so the run
+            ledger (``observability/ledger.py``) can join streams from
+            one run. Auto-generated when left None.
     """
 
     loss_fn: Callable[..., Any]
@@ -118,8 +125,11 @@ class Trainer:
     checkpoints: Any = None
     auto_layout: Any = None
     fleet: Any = None
+    run_id: str | None = None
 
     def __post_init__(self) -> None:
+        if self.run_id is None:
+            self.run_id = ledger_lib.new_run_id()
         if self.fleet is not None:
             if self.auto_layout is not None:
                 raise ValueError(
@@ -205,6 +215,9 @@ class Trainer:
             'trainer.step/no_stats',
             jax.jit(self._step_no_stats, donate_argnums=donate),
         )
+        watch = self._compile_watch()
+        if watch is not None:
+            watch.run_id = self.run_id
 
     # ------------------------------------------------------------- builders
 
@@ -236,6 +249,13 @@ class Trainer:
         watch, so engine.compiled_memory_report() covers both surfaces."""
         watcher = getattr(self.kfac, 'compile_watcher', None)
         return watcher() if callable(watcher) else None
+
+    def run_header(self, stream: str) -> dict[str, Any]:
+        """The shared run-header record for one telemetry stream — pass
+        to ``JSONLWriter(path, run_header=trainer.run_header('metrics'))``
+        so metrics, flight drains, and the compile journal from this run
+        self-identify to the run ledger."""
+        return ledger_lib.run_header(self.run_id, stream)
 
     def _watched(self, entry, fn, static_argnames=()):
         """Route a jitted step path through the engine's compile watch
@@ -370,6 +390,9 @@ class Trainer:
             'trainer.step/no_stats',
             jax.jit(self._step_no_stats, donate_argnums=donate),
         )
+        watch = self._compile_watch()
+        if watch is not None:
+            watch.run_id = self.run_id
         self._step_count = None  # resyncs from the next state's counter
         if self.checkpoints is not None:
             self.checkpoints.engine = engine

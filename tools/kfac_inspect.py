@@ -30,10 +30,19 @@ Deliberately dependency-free (stdlib only — no jax, no numpy): bundles
 are meant to be inspected on any machine, including ones without the
 training environment.
 
+``--timeline`` routes the input through the unified run ledger
+(``kfac_tpu/observability/ledger.py``, loaded standalone — still no jax)
+instead of the two separate analyses: a run directory of stream files
+(or one mixed JSONL) becomes a single correlated anomaly timeline where
+the "died compiling X" verdict and the divergence first-bad-signal
+verdict from the same run appear in ONE report, joined across streams by
+the ledger's correlation rules (see docs/OBSERVABILITY.md "Run ledger").
+
 Usage:
 
     python tools/kfac_inspect.py metrics.jsonl
     python tools/kfac_inspect.py postmortems/postmortem-step00000042-skip
+    python tools/kfac_inspect.py --timeline tests/data/mini_ledger
     python tools/kfac_inspect.py --json BUNDLE_OR_JSONL
     python tools/kfac_inspect.py --selftest
 
@@ -476,6 +485,19 @@ def selftest() -> int:
         assert bundle['compile_events'][0]['entry'] == 'kfac.step'
         assert bundle['compile_events'][0]['diff'] == [
             '[0][0]: dim 0 32 -> 64']
+    # --timeline: a mixed journal (killed mid-compile) + diverging
+    # metrics routes BOTH verdicts through the ledger into one report
+    ledger = _load_ledger()
+    led = ledger.RunLedger()
+    c_recs, m_recs = split_compile_records(journal[3:5] + records)
+    led.ingest('compile', c_recs)
+    led.ingest('metrics', m_recs)
+    report = ledger.timeline_report(led)
+    assert 'died compiling trainer.step' in report['verdicts']['compile'], \
+        report['verdicts']
+    assert 'first bad signal' in report['verdicts']['divergence'], \
+        report['verdicts']
+
     print('kfac_inspect selftest ok')
     return 0
 
@@ -483,10 +505,56 @@ def selftest() -> int:
 # -------------------------------------------------------------------- main
 
 
+def _load_ledger() -> Any:
+    """Load the stdlib-only ledger module from its file, bypassing the
+    package ``__init__`` (which imports jax)."""
+    import importlib.util
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'kfac_tpu', 'observability', 'ledger.py')
+    spec = importlib.util.spec_from_file_location('_kfac_ledger', path)
+    assert spec is not None and spec.loader is not None
+    module = importlib.util.module_from_spec(spec)
+    sys.modules['_kfac_ledger'] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def timeline(path: str, as_json: bool = False) -> int:
+    """One correlated report over a run directory or a mixed JSONL:
+    compile verdicts and divergence verdicts from the same run, joined
+    by the ledger instead of two separate CLI invocations."""
+    ledger = _load_ledger()
+    led = ledger.RunLedger()
+    if os.path.isdir(path):
+        if not led.ingest_dir(path):
+            print(f'error: no recognizable stream files under {path}',
+                  file=sys.stderr)
+            return 2
+    else:
+        records = load_jsonl(path)
+        compile_recs, metric_recs = split_compile_records(records)
+        if compile_recs:
+            led.ingest('compile', compile_recs)
+        if metric_recs:
+            led.ingest('metrics', metric_recs)
+        led.assign_steps()
+    if as_json:
+        json.dump(ledger.timeline_report(led), sys.stdout, indent=2,
+                  sort_keys=True)
+        print()
+    else:
+        sys.stdout.write(ledger.render_timeline(led))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split('\n')[0])
     parser.add_argument('path', nargs='?',
                         help='metrics JSONL file or postmortem bundle dir')
+    parser.add_argument('--timeline', action='store_true',
+                        help='render PATH (run dir or mixed JSONL) as a '
+                             'correlated cross-stream anomaly timeline')
     parser.add_argument('--json', action='store_true',
                         help='emit the analysis as JSON instead of text')
     parser.add_argument('--selftest', action='store_true',
@@ -497,6 +565,8 @@ def main(argv: list[str] | None = None) -> int:
         return selftest()
     if not args.path:
         parser.error('PATH required (or --selftest)')
+    if args.timeline:
+        return timeline(args.path, as_json=args.json)
 
     bundle = None
     if os.path.isdir(args.path):
