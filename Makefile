@@ -16,7 +16,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 	XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: test test-fast test-unit test-integration faults async compress fleet chaos compilewatch ledger obs prof tune resilience lint lint-ir lint-pod inspect bench bench-acc native
+.PHONY: test test-fast test-unit test-integration faults async compress fleet chaos compilewatch ledger serve obs prof tune resilience lint lint-ir lint-pod inspect bench bench-acc native
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q
@@ -96,6 +96,13 @@ ledger:
 	$(PY) tools/kfac_ledger.py --check tests/data/mini_ledger/bench_round.json \
 		--baseline tests/data/mini_ledger/LEDGER.json
 
+# posterior serving tier: bucketed-engine suite (MC/closed-form parity
+# across padding buckets, routing, zero-recompile pins, KFL114) and the
+# kfac_serve CLI selftest (see docs/SERVING.md)
+serve:
+	$(TEST_ENV) $(PY) -m pytest tests/test_serving.py -q
+	$(TEST_ENV) $(PY) tools/kfac_serve.py --selftest
+
 # telemetry spine: observability + flight-recorder test suites, the
 # compression/offload suite (its wire-bytes accounting is part of the
 # comms report contract), the self-driving fleet suite (its drift
@@ -110,8 +117,10 @@ ledger:
 # KFL101-KFL103/KFL105/KFL106/KFL108/KFL109/KFL111/KFL112 plus the
 # IR-tier smoke pass via lint-ir), the unified run ledger (ledger:
 # adapters, correlation timeline, perf-regression sentinel, KFL113),
-# and the kfac_inspect analysis selftest (see docs/OBSERVABILITY.md)
-obs: async lint compress fleet chaos prof compilewatch ledger
+# the posterior serving tier (serve: bucketed-engine parity + routing +
+# recompile pins + the kfac_serve selftest, KFL114), and the
+# kfac_inspect analysis selftest (see docs/OBSERVABILITY.md)
+obs: async lint compress fleet chaos prof compilewatch ledger serve
 	$(TEST_ENV) $(PY) -m pytest tests/test_observability.py \
 		tests/test_flight_recorder.py -q
 	$(PY) tools/kfac_inspect.py --selftest

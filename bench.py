@@ -836,6 +836,145 @@ def _compile_probe(reg, run, params, data) -> dict:
     return out
 
 
+_SERVING_SHAPES = (8, 32, 64)
+
+
+def _serving_probe() -> dict:
+    """Posterior serving probe (docs/SERVING.md): request latency
+    p50/p95 and requests/s at three batch shapes through BOTH compiled
+    paths (MC predictive and closed-form last-layer variance), the
+    cold-vs-warm AOT warmup A/B over a fresh persistent compile cache
+    (warm must be faster — the disk cache is what makes replica
+    bring-up cheap), and the steady-state recompile count, which must
+    be 0: every batch shape lands in a pre-compiled padding bucket.
+
+    Latencies come from the warm engine so the numbers describe a
+    replica in steady state, not one paying first-compile costs.
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import kfac_tpu
+    from kfac_tpu import health as health_lib
+    from kfac_tpu.models import MLP
+    from kfac_tpu.serving import ServingConfig, ServingEngine
+
+    # toy classifier: one factor update is all the export needs
+    m = MLP(features=(8,), num_classes=4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 6))
+    y = jax.random.randint(jax.random.PRNGKey(2), (64,), 0, 4)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg, health=health_lib.HealthConfig(warn=False))
+
+    def loss_fn(p, b):
+        xx, yy = b
+        logits = m.apply({'params': p}, xx)
+        onehot = jax.nn.one_hot(yy, 4)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+
+    cap = kfac_tpu.CurvatureCapture(reg)
+    _, grads, stats = cap.value_stats_and_grad(loss_fn)(params, (x, y))
+    state = kfac.update_factors(kfac.init(), stats)
+
+    post_dir = tempfile.mkdtemp(prefix='serving_probe_post_')
+    kfac_tpu.export_posterior(
+        kfac, state, params, post_dir,
+        config=kfac_tpu.laplace.LaplaceConfig(mode='last_layer'),
+        overwrite=True,
+    )
+    post = kfac_tpu.load_posterior(post_dir)
+
+    def apply_fn(p, xx):
+        return m.apply({'params': p}, xx)
+
+    def phi_fn(p, xx):
+        h = xx.reshape(xx.shape[0], -1)
+        return jax.nn.relu(h @ p['dense0']['kernel'] + p['dense0']['bias'])
+
+    cfg = ServingConfig(
+        bucket_granularity=8, max_batch=64, n_samples=8,
+        warmup_batches=_SERVING_SHAPES,
+    )
+
+    def build():
+        return ServingEngine(post, apply_fn, phi_fn=phi_fn, config=cfg)
+
+    # cold-vs-warm A/B over a FRESH persistent cache dir: engine A pays
+    # real XLA compiles and populates the disk cache; engine B re-traces
+    # the same programs and must warm-start from it, measurably faster
+    cache_dir = tempfile.mkdtemp(prefix='serving_probe_cache_')
+    saved = {
+        k: getattr(jax.config, k)
+        for k in ('jax_compilation_cache_dir',
+                  'jax_persistent_cache_min_entry_size_bytes',
+                  'jax_persistent_cache_min_compile_time_secs')
+    }
+    # the cache enable/disable decision latches at the process's first
+    # compile — reset so the fresh dir takes effect mid-process (and
+    # again afterwards so the rest of the stage keeps its own cache)
+    from jax._src import compilation_cache as cc_lib
+
+    try:
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)
+        cc_lib.reset_cache()
+        key = jax.random.PRNGKey(0)
+        cold = build().warmup(x_spec=x[:1], key=key)
+        eng = build()
+        warm = eng.warmup(x_spec=x[:1], key=key)
+    finally:
+        for k, v in saved.items():
+            jax.config.update(k, v)
+        cc_lib.reset_cache()
+
+    out: dict = {
+        'warmup_cold': cold,
+        'warmup_warm': warm,
+        'warm_faster': warm['seconds'] < cold['seconds'],
+        'shapes': {},
+    }
+
+    paths = ['mc']
+    if eng.closed_form_available:
+        paths.append('closed_form')
+    for b in _SERVING_SHAPES:
+        xb = x[:b]
+        for path in paths:
+            lats = []
+            for i in range(20):
+                res = eng.serve(
+                    xb, key=jax.random.PRNGKey(100 + i), path=path)
+                lats.append(res.latency_s)
+            p50 = float(np.percentile(lats, 50)) * 1e3
+            p95 = float(np.percentile(lats, 95)) * 1e3
+            out['shapes'][f'{path}.b{b}'] = {
+                'batch': b,
+                'p50_ms': round(p50, 3),
+                'p95_ms': round(p95, 3),
+                'requests_per_sec': round(b / (p50 / 1e3), 1),
+            }
+    out['recompiles_after_warmup'] = eng.recompiles_after_warmup()
+
+    # flat headline keys at the biggest shape — the DEFAULT_SENTINEL_KEYS
+    # surface the perf sentinel gates (latency lower-is-better)
+    big = _SERVING_SHAPES[-1]
+    for path, tag in (('mc', 'mc'), ('closed_form', 'cf')):
+        row = out['shapes'].get(f'{path}.b{big}')
+        if row is None:
+            continue
+        out[f'serving_{tag}_p50_ms'] = row['p50_ms']
+        out[f'serving_{tag}_p95_ms'] = row['p95_ms']
+        out[f'serving_{tag}_requests_per_sec'] = row['requests_per_sec']
+    eng.close()
+    return out
+
+
 def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     """Observability probe: per-step metrics JSONL, metrics-on overhead vs
     a metrics-off loop timed back-to-back, and a phase-level step-time
@@ -1000,6 +1139,19 @@ def _obs_probe(result, out_path, reg, run, loss, opt, params, data):
     _atomic_write(out_path, result)
     _log('  compile probe (recompile attribution + XLA memory + cache hit/miss)')
     result['compile_probe'] = _compile_probe(reg, run, params, data)
+
+    # posterior serving tier: bucketed latency + cold/warm warmup A/B
+    _atomic_write(out_path, result)
+    _log('  serving probe (p50/p95 both paths, cold-vs-warm AOT warmup)')
+    probe = _serving_probe()
+    result['serving_probe'] = probe
+    # lift the sentinel-gated flat keys (DEFAULT_SENTINEL_KEYS) so the
+    # ledger probe can diff them against the committed baseline
+    for k in ('serving_mc_p50_ms', 'serving_mc_p95_ms',
+              'serving_cf_p50_ms', 'serving_cf_p95_ms',
+              'serving_mc_requests_per_sec', 'serving_cf_requests_per_sec'):
+        if k in probe:
+            result[k] = probe[k]
 
 
 # ---------------------------------------------------------------------------
@@ -1553,6 +1705,14 @@ _HEADLINE_KEYS = (
     # persistent compile-cache hit/miss deltas (docs/OBSERVABILITY.md
     # "Compile & memory truth")
     'compile_probe',
+    # posterior serving tier: per-bucket p50/p95 + req/s on both paths,
+    # cold-vs-warm AOT warmup A/B, recompiles-after-warmup (must be 0),
+    # plus the flat sentinel-gated latency/throughput keys
+    # (docs/SERVING.md)
+    'serving_probe',
+    'serving_mc_p50_ms', 'serving_mc_p95_ms',
+    'serving_cf_p50_ms', 'serving_cf_p95_ms',
+    'serving_mc_requests_per_sec', 'serving_cf_requests_per_sec',
     # perf-regression sentinel verdict: this round's headline keys vs the
     # committed provenance-aware baseline bench_runs/LEDGER.json
     # (docs/OBSERVABILITY.md "Run ledger")

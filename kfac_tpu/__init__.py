@@ -46,6 +46,8 @@ from kfac_tpu.laplace import (
     fit_prior_precision,
     load_posterior,
 )
+from kfac_tpu import serving
+from kfac_tpu.serving import ServingConfig, ServingEngine
 from kfac_tpu.layers.capture import CapturedStats, CurvatureCapture
 from kfac_tpu.layers.registry import (
     Registry,
@@ -85,6 +87,8 @@ __all__ = [
     'PostmortemWriter',
     'Preempted',
     'Registry',
+    'ServingConfig',
+    'ServingEngine',
     'TunedPlan',
     'health',
     'resilience',
@@ -103,6 +107,7 @@ __all__ = [
     'merge_registries',
     'observability',
     'register_model',
+    'serving',
     'tracing',
     'warnings',
 ]

@@ -1,4 +1,4 @@
-"""KFL100–KFL113: the migrated docs-vs-code drift linters.
+"""KFL100–KFL114: the migrated docs-vs-code drift linters.
 
 These are ``kind='project'`` rules — unlike the AST rules they import
 the live ``kfac_tpu`` modules and compare real objects (metric schemas,
@@ -29,6 +29,7 @@ ANALYSIS_DOC = 'docs/ANALYSIS.md'
 OBSERVABILITY_DOC = 'docs/OBSERVABILITY.md'
 AUTOTUNE_DOC = 'docs/AUTOTUNE.md'
 ROBUSTNESS_DOC = 'docs/ROBUSTNESS.md'
+SERVING_DOC = 'docs/SERVING.md'
 ARCHITECTURE_DOC = 'docs/ARCHITECTURE.md'
 LAPLACE_DOC = 'docs/LAPLACE.md'
 
@@ -653,6 +654,41 @@ def _ledger_tables() -> list[core.Finding]:
     return _doc_findings('KFL113', OBSERVABILITY_DOC, line, problems)
 
 
+# ------------------------------------------------- KFL114 serving-tier knobs
+
+
+def check_serving_knobs(doc_path: str = SERVING_DOC) -> list[str]:
+    """Drift between the docs/SERVING.md "Serving knobs" table and the
+    ``ServingConfig`` dataclass fields — the bucketing, sampling,
+    escalation and metrics knobs the posterior serving engine accepts."""
+    import dataclasses
+
+    section, _ = doc_section(doc_path, '### Serving knobs')
+    documented = table_first_cells(section)
+    from kfac_tpu.serving import config as serving_config_lib
+
+    actual = {
+        f.name
+        for f in dataclasses.fields(serving_config_lib.ServingConfig)
+    }
+    problems = []
+    for k in sorted(actual - documented):
+        problems.append(f'undocumented config field (add to {doc_path}): {k}')
+    for k in sorted(documented - actual):
+        problems.append(
+            f'documented knob is not a ServingConfig field: {k}')
+    return problems
+
+
+def _serving_knobs() -> list[core.Finding]:
+    try:
+        _, line = doc_section(SERVING_DOC, '### Serving knobs')
+        problems = check_serving_knobs()
+    except (OSError, ValueError) as exc:
+        return _doc_findings('KFL114', SERVING_DOC, 1, [str(exc)])
+    return _doc_findings('KFL114', SERVING_DOC, line, problems)
+
+
 # --------------------------------------------------------------- registration
 
 
@@ -819,6 +855,20 @@ core.register(core.Rule(
         'triage against tables that lie, and a phantom sentinel key means '
         'CI enforces a tolerance nobody can look up',
     check=_ledger_tables,
+    kind='project',
+))
+
+core.register(core.Rule(
+    code='KFL114',
+    name='serving-knobs-doc',
+    what='drift between the docs/SERVING.md "Serving knobs" table and '
+         'the serving.ServingConfig dataclass fields',
+    why='the serving engine is the uncertainty-inference front door over '
+        'the Laplace export, and its bucket/escalation knobs decide both '
+        'compile count and answer quality; an undocumented (or phantom) '
+        'knob means production routing behavior is configured by '
+        'folklore',
+    check=_serving_knobs,
     kind='project',
 ))
 

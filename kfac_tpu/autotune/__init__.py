@@ -19,6 +19,7 @@ from kfac_tpu.autotune.model import (
     StaticLayout,
     candidate_config,
     predict,
+    price_serving,
 )
 from kfac_tpu.autotune.plan import (
     KNOB_KEYS,
@@ -58,5 +59,6 @@ __all__ = [
     'plan_fingerprint',
     'plan_schema_keys',
     'predict',
+    'price_serving',
     'resolve_auto_layout',
 ]

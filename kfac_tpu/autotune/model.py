@@ -161,6 +161,10 @@ class Candidate:
             # KAISA-grid candidates carry no mesh factorization; the 3D
             # planner (kfac_tpu.planner) overrides this on its rows
             'topology': None,
+            # serving-tier pricing is not part of the training grid —
+            # autotune(serving=...) attaches price_serving() output to
+            # the winning plan's knobs after the search
+            'serving': None,
         }
 
 
@@ -407,4 +411,92 @@ def predict(
             + host_transfer_s / cand.inv_update_steps
             + offload_transfer_s
         ),
+    }
+
+
+def _layer_dims(registry: Any) -> list[tuple[int, int]]:
+    """Per-layer (da, dg) in the posterior's deterministic layer order
+    (``sample_params`` folds keys over ``sorted(layers)`` — same here)."""
+    return [
+        (registry.layers[name].a_factor_shape[0],
+         registry.layers[name].g_factor_shape[0])
+        for name in sorted(registry.layers)
+    ]
+
+
+def price_serving(
+    registry: Any,
+    serving: Any,
+    hardware: HardwareSpec = HardwareSpec(),
+) -> dict[str, Any]:
+    """Serving-tier cost summary for a plan's ``serving`` knob.
+
+    Same host-side shape arithmetic as :func:`predict`, applied to the
+    inference engine (``kfac_tpu/serving/engine.py``) instead of the
+    training step:
+
+    - **MC path** per padded bucket: ``n_samples`` posterior draws (the
+      kron sample is two stacked matmuls per layer, ``2 dg da (dg+da)``
+      FLOPs) plus ``n_samples`` forward applies of the padded batch
+      (``2 b da dg`` per layer);
+    - **closed-form path** per bucket: one MAP apply plus the last-layer
+      linearized variance (the ``phi @ qa`` rotation and eigen-weighted
+      square, ``~2 b da (da+1)``, plus the ``(qg*qg) @ inv_g`` diagonal);
+    - **per-replica HBM**: MAP params plus the posterior arrays every
+      replica holds resident (``qa``/``qg``/``da``/``dg`` per layer, f32).
+
+    Buckets come from ``serving.warmup_batches`` through the same
+    ``size_class`` grammar the engine pads with; with no warmup list the
+    granularity floor and ``max_batch`` ceiling bound the range. The
+    returned dict is what ``autotune(serving=...)`` writes into
+    ``TunedPlan.knobs['serving']``.
+    """
+    from kfac_tpu.parallel import kaisa as kaisa_lib
+
+    dims = _layer_dims(registry)
+    if not dims:
+        raise ValueError('price_serving needs a registry with layers')
+    gran = int(serving.bucket_granularity)
+    max_batch = int(serving.max_batch)
+    n_mc = int(serving.n_samples or 1)
+    n_esc = int(serving.escalated_n_samples)
+
+    sizes = tuple(serving.warmup_batches) or (gran, max_batch)
+    buckets = sorted({
+        kaisa_lib.size_class(min(int(b), max_batch), gran) for b in sizes
+    })
+
+    apply_flops = float(sum(2.0 * da * dg for da, dg in dims))  # per example
+    sample_flops = float(sum(2.0 * dg * da * (dg + da) for da, dg in dims))
+    # closed-form variance prices against the LAST layer only — the path
+    # exists only for mode='last_layer' exports
+    da_ll, dg_ll = dims[-1]
+    rows = []
+    for b in buckets:
+        mc = n_mc * (sample_flops + b * apply_flops)
+        cf = (
+            b * apply_flops
+            + 2.0 * b * da_ll * (da_ll + 1.0)
+            + 2.0 * dg_ll * dg_ll
+        )
+        rows.append({
+            'bucket': int(b),
+            'mc_flops': mc,
+            'cf_flops': cf,
+            'escalated_mc_flops': n_esc * (sample_flops + b * apply_flops),
+            'mc_s': mc / hardware.matmul_flops,
+            'cf_s': cf / hardware.matmul_flops,
+        })
+
+    param_bytes = float(sum(4.0 * da * dg for da, dg in dims))
+    posterior_bytes = float(sum(
+        4.0 * (da * da + dg * dg + da + dg) for da, dg in dims
+    ))
+    return {
+        'bucket_granularity': gran,
+        'max_batch': max_batch,
+        'n_samples': n_mc,
+        'escalated_n_samples': n_esc,
+        'buckets': rows,
+        'hbm_bytes_per_replica': param_bytes + posterior_bytes,
     }

@@ -45,6 +45,7 @@ KNOB_KEYS = (
     'stat_compression',
     'offload',
     'topology',
+    'serving',
 )
 
 # Knobs added after schema-v1 plans shipped: absent in older documents,
@@ -58,6 +59,13 @@ OPTIONAL_KNOBS: dict[str, Any] = {
     # grad_worker_fraction — resolve_auto_layout consumes it, apply_knobs
     # leaves the config untouched.
     'topology': None,
+    # PR-20 serving-tier cost summary (model.price_serving output):
+    # {bucket_granularity, max_batch, n_samples, escalated_n_samples,
+    # buckets: [{bucket, mc_flops, cf_flops, ...}, ...],
+    # hbm_bytes_per_replica} or None when the plan wasn't priced for
+    # inference. Consumed by the serving tier (docs/SERVING.md);
+    # apply_knobs leaves the training config untouched.
+    'serving': None,
 }
 
 

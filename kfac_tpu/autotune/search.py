@@ -247,6 +247,7 @@ def autotune(
     warmup: int = 1,
     iters: int = 5,
     topology: bool | Any = False,
+    serving: Any = None,
 ) -> plan_lib.TunedPlan:
     """Run the full search and return the :class:`TunedPlan`.
 
@@ -260,20 +261,33 @@ def autotune(
     3D DP×TP×PP planner (:func:`kfac_tpu.planner.plan_topology`) ranks
     mesh factorizations instead; pass a
     :class:`~kfac_tpu.planner.TopologyConfig` to bound the factor grid.
+
+    Pass ``serving=`` a :class:`~kfac_tpu.serving.ServingConfig` to also
+    price the inference tier (:func:`kfac_tpu.autotune.model.price_serving`)
+    into the winning plan's ``knobs['serving']`` — per-bucket MC and
+    closed-form apply FLOPs plus per-replica HBM, so a deployment can
+    shape replica counts from the same artifact it trains with.
     """
     import jax
 
     if world is None:
         world = jax.device_count()
+    serving_knob = (
+        None if serving is None
+        else model_lib.price_serving(base.registry, serving, hardware)
+    )
     if topology:
         from kfac_tpu import planner as planner_lib
 
         kwargs = {}
         if isinstance(topology, planner_lib.TopologyConfig):
             kwargs['config'] = topology
-        return planner_lib.plan_topology(
+        topo_plan = planner_lib.plan_topology(
             base, world=world, hardware=hardware, **kwargs,
         )
+        if serving_knob is not None:
+            topo_plan.knobs['serving'] = serving_knob
+        return topo_plan
     cands = enumerate_candidates(
         world, base, fractions=fractions, granularities=granularities,
         transports=transports, inv_cadences=inv_cadences,
@@ -342,9 +356,14 @@ def autotune(
 
     table = [rows[i] for i in order]
     win = rows[winner_i]
+    win_knobs = dict(win['knobs'])
+    if serving_knob is not None:
+        # serving cost rides the winning plan only — cost_table rows keep
+        # their grid knobs untouched
+        win_knobs['serving'] = serving_knob
     return plan_lib.TunedPlan(
         fingerprint=plan_lib.plan_fingerprint(base.registry),
-        knobs=win['knobs'],
+        knobs=win_knobs,
         cost_table=table,
         winner={
             'strategy': win['knobs']['strategy'],

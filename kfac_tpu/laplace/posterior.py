@@ -108,6 +108,11 @@ class LaplacePosterior:
     param_paths: dict[str, tuple[str, ...]]
     fingerprint: dict[str, Any] = dataclasses.field(default_factory=dict)
     meta: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # per-apply_fn bucketed serving engines (kfac_tpu/serving/) backing
+    # :meth:`predictive`; init=False so dataclasses.replace (prior
+    # refits) starts clean instead of sampling a stale config
+    _engines: dict[int, Any] = dataclasses.field(
+        default_factory=dict, init=False, repr=False, compare=False)
 
     def _sample_matrix(
         self, name: str, w_map: jax.Array, key: jax.Array
@@ -148,6 +153,28 @@ class LaplacePosterior:
             params = _set_path(params, path, helper.matrix_to_grads(w))
         return params
 
+    def serving_engine(
+        self,
+        apply_fn: Callable[[Any, jax.Array], jax.Array],
+        **engine_kwargs: Any,
+    ) -> Any:
+        """The cached bucketed serving engine for ``apply_fn``.
+
+        One :class:`~kfac_tpu.serving.ServingEngine` per distinct
+        ``apply_fn`` — the engine holds a strong reference, so the
+        ``id``-keyed cache cannot alias a collected function. Extra
+        kwargs (``phi_fn``, ``config``, ...) only apply on first
+        construction.
+        """
+        from kfac_tpu.serving import engine as engine_lib
+
+        cached = self._engines.get(id(apply_fn))
+        if cached is None or cached.apply_fn is not apply_fn:
+            cached = engine_lib.ServingEngine(
+                self, apply_fn, **engine_kwargs)
+            self._engines[id(apply_fn)] = cached
+        return cached
+
     def predictive(
         self,
         apply_fn: Callable[[Any, jax.Array], jax.Array],
@@ -159,13 +186,14 @@ class LaplacePosterior:
 
         ``apply_fn(params, x) -> logits``; returns the mean softmax over
         ``n_samples`` posterior draws (default ``config.n_samples``).
+        Routed through the bucketed serving engine
+        (kfac_tpu/serving/engine.py): the batch pads to its size class
+        and runs one compiled program per bucket, so sweeping batch
+        sizes no longer retraces the n-sample vmap per distinct shape
+        (pinned by tests/test_serving.py via testing/compile_pins.py).
         """
         n = int(n_samples if n_samples is not None else self.config.n_samples)
-        keys = jax.random.split(key, n)
-        probs = jax.vmap(
-            lambda k: jax.nn.softmax(apply_fn(self.sample_params(k), x))
-        )(keys)
-        return probs.mean(axis=0)
+        return self.serving_engine(apply_fn).mc_probs(x, key, n)
 
     def linearized_variance(self, phi: jax.Array) -> jax.Array:
         """Closed-form last-layer predictive variance of the logits.

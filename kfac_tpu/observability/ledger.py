@@ -1,8 +1,9 @@
 """Unified run ledger: one normalized event schema over every stream.
 
-The repo emits seven telemetry streams — metrics JSONL, flight-recorder
+The repo emits eight telemetry streams — metrics JSONL, flight-recorder
 drains, compile-watch journals/events, calibration records, trace-attrib
-breakdowns, fleet events, chaos worker events — plus bench round JSON.
+breakdowns, fleet events, chaos worker events, serving-engine request
+records — plus bench round JSON.
 Each is independently useful; none joins. This module is the synthesis
 layer: per-stream adapters parse the formats **already committed** (no
 producer rewrite) into one event shape keyed by
@@ -324,6 +325,29 @@ def parse_bench(source: Any) -> list[dict[str, Any]]:
         run_id=str(rid) if rid is not None else None)]
 
 
+def parse_serving(source: Any) -> list[dict[str, Any]]:
+    """Serving-engine request records (``kfac_tpu/serving/engine.py``
+    metrics JSONL): one ``serve`` event per answered request batch,
+    carrying path, request count, bucket(s), sample count, escalations,
+    and latency. Step-less — serving happens outside the training step
+    clock — so events order by wall clock."""
+    run_id, records = _split_header(_records(source))
+    events = []
+    for rec in records:
+        if rec.get('kind') not in (None, 'serve'):
+            continue
+        lat = _num(rec.get('latency_ms'))
+        detail = (
+            f"{rec.get('path', '?')} requests={rec.get('requests', '?')} "
+            + (f'{lat:g}ms' if lat is not None else '?ms'))
+        if _num(rec.get('n_escalated')):
+            detail += f" escalated={rec['n_escalated']}"
+        events.append(_make_event(
+            'serving', 'serve', detail, rec,
+            run_id=run_id, t=_num(rec.get('t'))))
+    return events
+
+
 #: stream-adapter registry: stream name -> parse callable. Pinned to the
 #: docs/OBSERVABILITY.md stream-adapter matrix by KFL113.
 ADAPTERS: dict[str, Callable[[Any], list[dict[str, Any]]]] = {
@@ -334,6 +358,7 @@ ADAPTERS: dict[str, Callable[[Any], list[dict[str, Any]]]] = {
     'trace': parse_trace,
     'fleet': parse_fleet,
     'chaos': parse_chaos,
+    'serving': parse_serving,
     'bench': parse_bench,
 }
 
@@ -341,6 +366,9 @@ ADAPTERS: dict[str, Callable[[Any], list[dict[str, Any]]]] = {
 #: first match wins (``history.jsonl``/``compile_events.jsonl`` are the
 #: postmortem-bundle names)
 _DISCOVERY: tuple[tuple[str, str], ...] = (
+    # 'serving' outranks 'metrics' so a producer's serving_metrics.jsonl
+    # lands on the serving adapter, not the training-metrics one
+    ('serving', 'serving'),
     ('metrics', 'metrics'),
     ('history', 'flight'),
     ('flight', 'flight'),
@@ -780,6 +808,15 @@ DEFAULT_SENTINEL_KEYS: dict[str, dict[str, Any]] = {
     'mfu': {'direction': 'higher', 'tolerance': 0.15},
     'acc_step_ratio': {'direction': 'lower', 'tolerance': 0.25},
     'acc_time_ratio': {'direction': 'lower', 'tolerance': 0.25},
+    # serving-probe headline keys (bench.py _serving_probe): latency is
+    # lower-is-better, throughput higher; 0.25 absorbs shared-host
+    # timing jitter like the acc ratios above
+    'serving_mc_p50_ms': {'direction': 'lower', 'tolerance': 0.25},
+    'serving_mc_p95_ms': {'direction': 'lower', 'tolerance': 0.25},
+    'serving_cf_p50_ms': {'direction': 'lower', 'tolerance': 0.25},
+    'serving_cf_p95_ms': {'direction': 'lower', 'tolerance': 0.25},
+    'serving_mc_requests_per_sec': {'direction': 'higher', 'tolerance': 0.25},
+    'serving_cf_requests_per_sec': {'direction': 'higher', 'tolerance': 0.25},
 }
 
 

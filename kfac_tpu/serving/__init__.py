@@ -1,0 +1,26 @@
+"""Posterior serving tier: production inference over a Laplace export.
+
+:class:`ServingEngine` answers batched prediction requests against a
+loaded :class:`~kfac_tpu.laplace.LaplacePosterior` — Monte-Carlo
+predictive and closed-form last-layer variance paths, request batches
+padded to a fixed set of compiled size classes, AOT warm start through
+the CompileWatch machinery, and uncertainty-aware escalation routing.
+See docs/SERVING.md.
+"""
+
+from kfac_tpu.serving.config import PATHS, ServingConfig
+from kfac_tpu.serving.engine import (
+    CF_ENTRY,
+    MC_ENTRY,
+    ServeResult,
+    ServingEngine,
+)
+
+__all__ = [
+    'CF_ENTRY',
+    'MC_ENTRY',
+    'PATHS',
+    'ServeResult',
+    'ServingConfig',
+    'ServingEngine',
+]

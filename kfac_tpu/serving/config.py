@@ -1,0 +1,106 @@
+"""Configuration for the posterior serving tier.
+
+The knobs here are the ONLY runtime parameters of a
+:class:`~kfac_tpu.serving.ServingEngine`; everything statistical
+(eigenbases, eigenvalues, MAP weights, prior precision, temperature)
+lives in the loaded :class:`~kfac_tpu.laplace.LaplacePosterior` and its
+:class:`~kfac_tpu.laplace.LaplaceConfig`. Serving knobs shape *how* the
+posterior is evaluated — bucket geometry, sample counts, escalation —
+not *what* it predicts.
+
+The knob table in docs/SERVING.md is pinned to these fields by the
+KFL114 drift rule (kfac_tpu/analysis/drift.py) — the same doc-vs-code
+contract as the Laplace (KFL107) and compile-watch (KFL112) knob
+tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: inference paths a request may be served on, in docs order:
+#: ``mc`` Monte-Carlo posterior predictive, ``closed_form`` last-layer
+#: linearized variance + MAP probabilities, ``auto`` uncertainty-aware
+#: routing (closed-form first, escalate to MC above the threshold)
+PATHS = ('mc', 'closed_form', 'auto')
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Knobs for :class:`kfac_tpu.serving.ServingEngine`.
+
+    Attributes:
+        bucket_granularity: size-class rounding for request batch
+            buckets — the ``parallel/kaisa.py`` ``size_class`` grammar
+            applied to the batch dimension. Arbitrary request sizes pad
+            up to a small fixed set of compiled shapes: sizes below the
+            granularity round to the next power of two (>= 8) capped at
+            the granularity; larger sizes round to the next multiple.
+            ``<= 1`` disables bucketing (every distinct size compiles).
+        max_batch: largest padded batch one compiled program serves;
+            bigger requests are split into ``max_batch`` chunks before
+            bucketing. Must be a multiple of ``bucket_granularity``
+            (when bucketing is on) so chunk buckets never overshoot it.
+        n_samples: Monte-Carlo sample count for the base ``mc`` path.
+            ``None`` defers to the posterior's own
+            ``LaplaceConfig.n_samples``.
+        escalated_n_samples: sample count for requests the ``auto``
+            router escalates — must be >= the base count (escalation
+            buys precision with FLOPs, never the reverse).
+        variance_threshold: closed-form per-request variance (max over
+            logits) above which an ``auto`` request escalates to the
+            escalated MC path. ``None`` disables escalation: ``auto``
+            serves everything closed-form.
+        warmup_batches: request sizes :meth:`~kfac_tpu.serving.
+            ServingEngine.warmup` pre-compiles (each rounds to its
+            bucket; duplicates collapse). Empty means warmup compiles
+            nothing and the first real request pays the compile.
+        metrics_path: serving-metrics JSONL path (the ledger's
+            ``serving`` stream; docs/OBSERVABILITY.md "Stream
+            adapters"). ``None`` disables emission.
+    """
+
+    bucket_granularity: int = 32
+    max_batch: int = 256
+    n_samples: int | None = None
+    escalated_n_samples: int = 32
+    variance_threshold: float | None = None
+    warmup_batches: tuple[int, ...] = ()
+    metrics_path: str | None = None
+
+    def __post_init__(self) -> None:
+        if self.max_batch < 1:
+            raise ValueError(
+                f'ServingConfig.max_batch must be >= 1, got {self.max_batch}'
+            )
+        if self.bucket_granularity > 1 \
+                and self.max_batch % self.bucket_granularity != 0:
+            raise ValueError(
+                'ServingConfig.max_batch must be a multiple of '
+                f'bucket_granularity (chunk buckets must not overshoot '
+                f'it), got max_batch={self.max_batch} '
+                f'granularity={self.bucket_granularity}'
+            )
+        if self.n_samples is not None and self.n_samples < 1:
+            raise ValueError(
+                'ServingConfig.n_samples must be >= 1 (or None to defer '
+                f'to the posterior), got {self.n_samples}'
+            )
+        base = self.n_samples if self.n_samples is not None else 1
+        if self.escalated_n_samples < max(1, base):
+            raise ValueError(
+                'ServingConfig.escalated_n_samples must be >= the base '
+                f'n_samples, got {self.escalated_n_samples} < {base}'
+            )
+        if self.variance_threshold is not None \
+                and self.variance_threshold <= 0:
+            raise ValueError(
+                'ServingConfig.variance_threshold must be positive (or '
+                f'None to disable routing), got {self.variance_threshold}'
+            )
+        for b in self.warmup_batches:
+            if not isinstance(b, int) or b < 1:
+                raise ValueError(
+                    'ServingConfig.warmup_batches must be positive ints, '
+                    f'got {self.warmup_batches!r}'
+                )

@@ -79,6 +79,7 @@ def _cli(*args):
     ('fleet', 'fleet.jsonl', 4, {'fleet_event'}),
     ('chaos', 'chaos.jsonl', 7, {'chaos_event'}),
     ('trace', 'trace.json', 3, {'trace_step', 'trace_summary'}),
+    ('serving', 'serving.jsonl', 4, {'serve'}),
     ('bench', 'bench_round.json', 1, {'bench_round'}),
 ])
 def test_adapter_parses_committed_format(stream, fname, count, kinds):
@@ -128,7 +129,7 @@ def test_ingest_dir_discovers_every_stream():
     rl = _fixture_ledger()
     assert rl.runs() == ['mini0001']
     assert rl.streams() == sorted(ledger.ADAPTERS)
-    assert len(rl.events) == 39
+    assert len(rl.events) == 43
 
 
 def test_step_clock_places_wall_clock_only_events():
@@ -229,7 +230,7 @@ def test_timeline_report_json_shape():
     report = ledger.timeline_report(_fixture_ledger())
     assert report['schema'] == ledger.LEDGER_SCHEMA
     assert report['runs'] == ['mini0001']
-    assert report['n_events'] == 39
+    assert report['n_events'] == 43
     assert report['verdicts']['compile'].startswith('ok')
     assert report['verdicts']['divergence'].startswith('none')
 

@@ -1,0 +1,488 @@
+"""Posterior serving tier tests (kfac_tpu/serving/, docs/SERVING.md).
+
+Pins PR 20's acceptance criteria:
+
+- bucketed outputs match direct posterior calls: the MC path is
+  bit-identical to the unpadded posterior-predictive formula under a
+  fixed key (weight draws depend only on the key, padded rows slice
+  off), across batch sizes that pad, fill, and chunk the buckets; the
+  closed-form path matches ``linearized_variance`` to float tolerance;
+- the same parity holds for an export from the *distributed* engine
+  (``parallel.DistributedKFAC``), not just the single-host
+  preconditioner;
+- ``LaplacePosterior.predictive`` no longer recompiles per batch shape:
+  three distinct request sizes inside one bucket land on ONE compile
+  (the ``testing/compile_pins.py`` pin against the engine's own
+  CompileWatch entry);
+- ``warmup`` compiles exactly the configured bucket set once
+  (re-warmup adds zero compiles) and ``recompiles_after_warmup`` reads
+  0 after serving every padding/filling/chunking size on both paths;
+- ``serve`` routing semantics: path validation, key requirements,
+  threshold escalation (whole-bucket MC + per-row select), the
+  closed-form fallback and the mc fallback for exports without a
+  closed form;
+- the metrics JSONL round-trips through the ledger's ``serving``
+  stream adapter with the engine's run header;
+- KFL114 pins the docs/SERVING.md knob table to the live
+  ``ServingConfig`` dataclass (clean doc passes, doctored copy caught,
+  rule registered).
+
+Compile budget: one module-scope trained model + one warmed module-scope
+engine carry the parity and steady-state tests; only the routing,
+fallback, distributed and predictive-pin tests build private engines
+(tiny model, few buckets each).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import kfac_tpu
+from kfac_tpu import health as health_lib
+from kfac_tpu.analysis import drift
+from kfac_tpu.laplace import LaplaceConfig
+from kfac_tpu.models import MLP
+from kfac_tpu.observability import ledger
+from kfac_tpu.parallel.kaisa import size_class
+from kfac_tpu.serving import PATHS, ServingConfig, ServingEngine
+from testing import compile_pins, models
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- fixtures
+
+
+@pytest.fixture(scope='module')
+def trained():
+    """One trained tiny classifier shared by every test in the module:
+    the engine/capture compiles are the expensive part, not the asserts."""
+    m = MLP(features=(8,), num_classes=4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 6))
+    y = jax.random.randint(jax.random.PRNGKey(2), (64,), 0, 4)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg, health=health_lib.HealthConfig(warn=False)
+    )
+
+    def loss_fn(p, b):
+        xx, yy = b
+        logits = m.apply({'params': p}, xx)
+        onehot = jax.nn.one_hot(yy, 4)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+
+    cap = kfac_tpu.CurvatureCapture(reg)
+    _, grads, stats = cap.value_stats_and_grad(loss_fn)(params, (x, y))
+    state = kfac.update_factors(kfac.init(), stats)
+
+    def apply_fn(p, xx):
+        return m.apply({'params': p}, xx)
+
+    def phi_fn(p, xx):
+        h = xx.reshape(xx.shape[0], -1)
+        return jax.nn.relu(h @ p['dense0']['kernel'] + p['dense0']['bias'])
+
+    return kfac, state, params, x, apply_fn, phi_fn
+
+
+@pytest.fixture(scope='module')
+def ll_dir(trained, tmp_path_factory):
+    """Committed-on-disk last_layer export shared by the module."""
+    kfac, state, params, _, _, _ = trained
+    path = tmp_path_factory.mktemp('serving') / 'll'
+    kfac_tpu.export_posterior(
+        kfac, state, params, path,
+        config=LaplaceConfig(mode='last_layer'), overwrite=True,
+    )
+    return str(path)
+
+
+@pytest.fixture(scope='module')
+def ll_post(ll_dir):
+    return kfac_tpu.load_posterior(ll_dir)
+
+
+@pytest.fixture(scope='module')
+def kron_post(trained, tmp_path_factory):
+    """Full-kron export: MC-only coverage (no closed form without a
+    last_layer mode)."""
+    kfac, state, params, _, _, _ = trained
+    path = tmp_path_factory.mktemp('serving') / 'kron'
+    kfac_tpu.export_posterior(kfac, state, params, path, overwrite=True)
+    return kfac_tpu.load_posterior(path)
+
+
+@pytest.fixture(scope='module')
+def warm_engine(ll_post, trained):
+    """One warmed engine shared by the parity/steady-state tests: the
+    warmup covers every bucket the tests serve (8/16/24/32), so the
+    compile set is paid once for the module."""
+    _, _, _, x, apply_fn, phi_fn = trained
+    eng = ServingEngine(
+        ll_post, apply_fn, phi_fn=phi_fn,
+        config=ServingConfig(
+            bucket_granularity=8, max_batch=32, n_samples=4,
+            warmup_batches=(8, 16, 24, 32),
+        ),
+    )
+    report = eng.warmup(x_spec=x[:1], key=jax.random.PRNGKey(0))
+    return eng, report
+
+
+def _ref_mc(post, apply_fn):
+    """The direct (unbucketed) posterior-predictive formula, jitted at
+    the request's own shape — the offline reference the engine must
+    match."""
+
+    def raw(xx, key, n):
+        keys = jax.random.split(key, n)
+
+        def one(k):
+            return jax.nn.softmax(apply_fn(post.sample_params(k), xx))
+
+        return jax.vmap(one)(keys).mean(0)
+
+    return jax.jit(raw, static_argnums=2)
+
+
+# ------------------------------------------------------------ config knobs
+
+
+def test_config_defaults_and_paths():
+    cfg = ServingConfig()
+    assert cfg.bucket_granularity == 32
+    assert cfg.max_batch == 256
+    assert cfg.n_samples is None
+    assert PATHS == ('mc', 'closed_form', 'auto')
+
+
+@pytest.mark.parametrize(
+    'kw, match',
+    [
+        ({'max_batch': 0}, 'max_batch'),
+        ({'bucket_granularity': 8, 'max_batch': 20}, 'multiple'),
+        ({'n_samples': 0}, 'n_samples'),
+        ({'n_samples': 8, 'escalated_n_samples': 4}, 'escalated'),
+        ({'variance_threshold': 0.0}, 'positive'),
+        ({'variance_threshold': -1.0}, 'positive'),
+        ({'warmup_batches': (8, 0)}, 'warmup_batches'),
+    ],
+)
+def test_config_validation(kw, match):
+    with pytest.raises(ValueError, match=match):
+        ServingConfig(**kw)
+
+
+def test_bucket_mapping_uses_size_class(warm_engine):
+    eng, _ = warm_engine
+    for n in (1, 3, 8, 9, 13, 17, 24, 31, 32):
+        assert eng.bucket(n) == size_class(n, 8)
+    # requests above max_batch clamp to it (the chunker splits first)
+    assert eng.bucket(999) == 32
+    with pytest.raises(ValueError, match='>= 1'):
+        eng.bucket(0)
+    # chunking: 50 rows under max_batch=32 -> one full chunk + an 18-row
+    # tail that buckets to 24
+    assert eng._chunks(50) == [(0, 32), (32, 18)]
+
+
+# ------------------------------------------------------- warmup & compiles
+
+
+def test_warmup_compiles_the_bucket_set_once(warm_engine, trained):
+    eng, report = warm_engine
+    _, _, _, x, _, _ = trained
+    assert report['buckets'] == [8, 16, 24, 32]
+    # two programs per bucket (base MC + closed form; no escalated MC
+    # without a variance_threshold), each compiled exactly once
+    assert report['compiles'] == 2 * len(report['buckets'])
+    # re-warmup is a no-op on the compile counter
+    again = eng.warmup(x_spec=x[:1], key=jax.random.PRNGKey(0))
+    assert again['compiles'] == 0
+    assert eng.recompiles_after_warmup() == 0
+
+
+def test_zero_recompiles_across_served_sizes(warm_engine, trained):
+    """The steady-state pin: every size that pads, fills, or chunks the
+    warmed buckets serves without a single fresh compile."""
+    eng, _ = warm_engine
+    _, _, _, x, _, _ = trained
+    key = jax.random.PRNGKey(3)
+    before = eng.watch.compile_count()
+    for b in (3, 8, 13, 16, 32, 50):
+        eng.mc_probs(x[:b], key)
+        eng.closed_form(x[:b])
+    assert eng.watch.compile_count() == before
+    assert eng.recompiles_after_warmup() == 0
+
+
+# --------------------------------------------------------- offline parity
+
+
+def test_mc_parity_bit_identical_across_buckets(warm_engine, ll_post,
+                                                trained):
+    """Bucketed MC == the direct posterior formula, bit for bit: the
+    weight draws depend only on the key (never the batch), padded rows
+    are sliced off, and every chunk reuses the same key. Sizes cover
+    padding (3, 13), an exact bucket fill (8, 32), and chunking (50)."""
+    eng, _ = warm_engine
+    _, _, _, x, apply_fn, _ = trained
+    ref = _ref_mc(ll_post, apply_fn)
+    key = jax.random.PRNGKey(7)
+    for b in (3, 8, 13, 32, 50):
+        got = np.asarray(eng.mc_probs(x[:b], key, n_samples=4))
+        want = np.asarray(ref(x[:b], key, 4))
+        np.testing.assert_array_equal(got, want, err_msg=f'batch {b}')
+        np.testing.assert_allclose(got.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_predictive_matches_engine(ll_post, trained):
+    """Offline ``predictive`` and the serving engine are the same code
+    path now — same key, same numbers."""
+    _, _, _, x, apply_fn, _ = trained
+    key = jax.random.PRNGKey(11)
+    off = np.asarray(ll_post.predictive(apply_fn, x[:13], key, n_samples=4))
+    eng = ll_post.serving_engine(apply_fn)
+    np.testing.assert_array_equal(
+        off, np.asarray(eng.mc_probs(x[:13], key, n_samples=4)))
+
+
+def test_closed_form_parity(warm_engine, ll_post, trained):
+    _, _, _, x, apply_fn, phi_fn = trained
+    eng, _ = warm_engine
+    for b in (3, 8, 13, 50):
+        probs, var = eng.closed_form(x[:b])
+        ref_probs = jax.nn.softmax(apply_fn(ll_post.params, x[:b]))
+        ref_var = ll_post.linearized_variance(phi_fn(ll_post.params, x[:b]))
+        np.testing.assert_allclose(
+            np.asarray(probs), np.asarray(ref_probs), rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(
+            np.asarray(var), np.asarray(ref_var), rtol=1e-6, atol=1e-7)
+
+
+def test_distributed_export_serves_identically(tmp_path):
+    """The serving tier is engine-agnostic: an export from
+    ``parallel.DistributedKFAC`` serves with the same bucketed-vs-direct
+    parity as the single-host preconditioner's."""
+    from kfac_tpu.parallel import DistributedKFAC, kaisa_mesh
+
+    m = models.TinyModel()
+    x, y = models.regression_data(jax.random.PRNGKey(1), n=32)
+    params = m.init(jax.random.PRNGKey(0), x)['params']
+    reg = kfac_tpu.register_model(m, x)
+    cfg = kfac_tpu.KFACPreconditioner(registry=reg, kl_clip=None)
+    dk = DistributedKFAC(config=cfg, mesh=kaisa_mesh(1.0))
+    cap = kfac_tpu.CurvatureCapture(reg)
+    (_, _), grads, stats = cap.value_stats_and_grad(
+        models.mse_loss(m))(params, (x, y))
+    state, _ = jax.jit(dk.step)(dk.init(), grads, stats)
+
+    kfac_tpu.export_posterior(
+        dk, state, params, tmp_path,
+        config=LaplaceConfig(mode='last_layer'), overwrite=True,
+    )
+    post = kfac_tpu.load_posterior(tmp_path)
+
+    def apply_fn(p, xx):
+        return m.apply({'params': p}, xx)
+
+    def phi_fn(p, xx):
+        return jax.nn.relu(xx @ p['fc1']['kernel'] + p['fc1']['bias'])
+
+    eng = ServingEngine(
+        post, apply_fn, phi_fn=phi_fn,
+        config=ServingConfig(bucket_granularity=8, max_batch=32,
+                             n_samples=4),
+    )
+    key = jax.random.PRNGKey(5)
+    ref = _ref_mc(post, apply_fn)
+    for b in (5, 11):
+        np.testing.assert_array_equal(
+            np.asarray(eng.mc_probs(x[:b], key)),
+            np.asarray(ref(x[:b], key, 4)), err_msg=f'batch {b}')
+    _, var = eng.closed_form(x[:11])
+    np.testing.assert_allclose(
+        np.asarray(var),
+        np.asarray(post.linearized_variance(phi_fn(post.params, x[:11]))),
+        rtol=1e-6, atol=1e-7)
+
+
+# ------------------------------------------------------------ compile pin
+
+
+def test_predictive_one_compile_across_batch_shapes(ll_dir, trained):
+    """The PR-20 recompile fix: sweeping ``predictive`` over distinct
+    batch shapes inside one padding bucket lands on ONE compiled
+    program (it used to retrace the n-sample vmap per shape). Pinned
+    with the shared testing/compile_pins.py helper against the engine's
+    own CompileWatch entry."""
+    _, _, _, x, apply_fn, _ = trained
+    post = kfac_tpu.load_posterior(ll_dir)  # fresh engine cache
+    key = jax.random.PRNGKey(13)
+    for b in (3, 5, 8):  # three shapes, one b8 bucket
+        probs = post.predictive(apply_fn, x[:b], key, n_samples=4)
+        assert probs.shape == (b, 4)
+    eng = post.serving_engine(apply_fn)
+    compile_pins.assert_compiled_once(
+        eng._watched_mc(8, 4), entry='serving.mc.b8.n4')
+    assert eng.recompiles_after_warmup() == 0
+    # the engine is cached per apply_fn: a fourth call adds nothing
+    post.predictive(apply_fn, x[:6], key, n_samples=4)
+    assert post.serving_engine(apply_fn) is eng
+    compile_pins.assert_compiled_once(
+        eng._watched_mc(8, 4), entry='serving.mc.b8.n4')
+
+
+# ----------------------------------------------------------- serve routing
+
+
+def test_serve_path_and_key_validation(warm_engine, trained):
+    eng, _ = warm_engine
+    _, _, _, x, _, _ = trained
+    with pytest.raises(ValueError, match='path'):
+        eng.serve(x[:3], key=jax.random.PRNGKey(0), path='bogus')
+    with pytest.raises(ValueError, match='key'):
+        eng.serve(x[:3], path='mc')
+
+
+def test_serve_result_fields(warm_engine, trained):
+    eng, _ = warm_engine
+    _, _, _, x, _, _ = trained
+    key = jax.random.PRNGKey(17)
+    res = eng.serve(x[:13], key=key, path='mc')
+    assert res.path == 'mc'
+    assert res.probs.shape == (13, 4)
+    assert res.variance is None and res.escalated is None
+    assert res.bucket == (16,)
+    assert res.latency_s > 0
+    res_cf = eng.serve(x[:50], path='closed_form')
+    assert res_cf.path == 'closed_form'
+    assert res_cf.variance.shape == (50, 4)
+    assert res_cf.bucket == (32, 24)
+    # no threshold configured: auto == closed_form, nothing escalates
+    res_auto = eng.serve(x[:8], path='auto')
+    assert res_auto.escalated is None
+    np.testing.assert_array_equal(
+        np.asarray(res_auto.probs),
+        np.asarray(eng.closed_form(x[:8])[0]))
+
+
+def test_auto_routing_escalates_above_threshold(ll_post, trained):
+    _, _, _, x, apply_fn, phi_fn = trained
+    key = jax.random.PRNGKey(19)
+
+    def build(threshold):
+        return ServingEngine(
+            ll_post, apply_fn, phi_fn=phi_fn,
+            config=ServingConfig(
+                bucket_granularity=8, max_batch=32, n_samples=4,
+                escalated_n_samples=16, variance_threshold=threshold,
+            ),
+        )
+
+    # a threshold below every variance escalates every row, and the
+    # escalated rows carry exactly the 16-sample MC answer
+    eng = build(1e-12)
+    res = eng.serve(x[:8], key=key, path='auto')
+    assert res.path == 'auto'
+    assert res.escalated.dtype == jnp.bool_
+    assert bool(jnp.all(res.escalated))
+    np.testing.assert_array_equal(
+        np.asarray(res.probs),
+        np.asarray(eng.mc_probs(x[:8], key, n_samples=16)))
+
+    # a threshold above every variance escalates nothing: the answer is
+    # the closed-form one and no MC program ever compiles
+    hi = build(1e9)
+    res_hi = hi.serve(x[:8], key=key, path='auto')
+    assert not bool(jnp.any(res_hi.escalated))
+    np.testing.assert_array_equal(
+        np.asarray(res_hi.probs), np.asarray(hi.closed_form(x[:8])[0]))
+    assert hi.watch.compile_count('serving.mc.b8.n16') == 0
+
+    # routing with a threshold needs a key for the escalated pass
+    with pytest.raises(ValueError, match='key'):
+        eng.serve(x[:3], path='auto')
+
+
+def test_auto_falls_back_to_mc_without_closed_form(kron_post, trained):
+    """A kron export has no closed form: ``auto`` degrades to the MC
+    path, ``closed_form`` refuses with the actionable message."""
+    _, _, _, x, apply_fn, _ = trained
+    eng = ServingEngine(
+        kron_post, apply_fn,
+        config=ServingConfig(bucket_granularity=8, max_batch=32,
+                             n_samples=4),
+    )
+    assert not eng.closed_form_available
+    res = eng.serve(x[:5], key=jax.random.PRNGKey(23), path='auto')
+    assert res.path == 'mc'
+    assert res.variance is None and res.escalated is None
+    with pytest.raises(ValueError, match='closed-form'):
+        eng.closed_form(x[:5])
+    with pytest.raises(ValueError, match='closed-form'):
+        eng.serve(x[:5], path='closed_form')
+
+
+# --------------------------------------------------------- ledger metrics
+
+
+def test_metrics_roundtrip_through_serving_adapter(ll_post, trained,
+                                                   tmp_path):
+    """With ``metrics_path`` set the engine appends one ``serve`` record
+    per answered batch under the shared run header, and the ledger's
+    ``serving`` adapter reads them back with the run_id attached."""
+    _, _, _, x, apply_fn, phi_fn = trained
+    mpath = str(tmp_path / 'serving.jsonl')
+    eng = ServingEngine(
+        ll_post, apply_fn, phi_fn=phi_fn,
+        config=ServingConfig(bucket_granularity=8, max_batch=32,
+                             n_samples=4, metrics_path=mpath),
+        run_id='abc123def456',
+    )
+    key = jax.random.PRNGKey(29)
+    eng.serve(x[:3], key=key, path='mc')
+    eng.serve(x[:50], path='closed_form')
+    eng.close()
+
+    assert ledger.ADAPTERS['serving'] is ledger.parse_serving
+    events = ledger.parse_serving(mpath)
+    assert len(events) == 2
+    assert all(e['stream'] == 'serving' and e['kind'] == 'serve'
+               for e in events)
+    assert all(e['run_id'] == 'abc123def456' for e in events)
+    assert events[0]['data']['requests'] == 3
+    assert events[0]['data']['bucket'] == [8]
+    assert events[0]['data']['path'] == 'mc'
+    assert events[1]['data']['bucket'] == [32, 24]
+    assert events[1]['data']['latency_ms'] > 0
+    # step-less stream: events carry wall clock, never a step
+    assert all(e['step'] is None and e['t'] is not None for e in events)
+
+
+# ------------------------------------------------------------------ drift
+
+
+def test_kfl114_clean_on_committed_doc():
+    assert drift.check_serving_knobs() == []
+
+
+def test_kfl114_catches_doc_drift(tmp_path):
+    doc = os.path.join(REPO, 'docs', 'SERVING.md')
+    with open(doc, encoding='utf-8') as f:
+        text = f.read()
+    doctored = tmp_path / 'SERVING.md'
+    doctored.write_text(
+        text.replace('| `variance_threshold` |', '| `varaince_threshold` |'))
+    problems = drift.check_serving_knobs(str(doctored))
+    assert problems
+    assert any('variance_threshold' in p for p in problems)
+
+
+def test_kfl114_registered():
+    rules = {r.code for r in drift.core.all_rules()}
+    assert 'KFL114' in rules

@@ -375,6 +375,141 @@ def run_calibration_gate(seed: int = 0) -> dict:
     return out
 
 
+def run_serving_routing_gate(seed: int = 0) -> dict:
+    """Uncertainty-aware routing must EARN its extra samples.
+
+    Trains the digits MLP under K-FAC (same recipe as the calibration
+    gate), exports a last-layer posterior, and serves the test set
+    through ``ServingEngine`` with ``path='auto'``: closed-form variance
+    above the threshold (the 80th percentile of test-set variance, so
+    ~20% of rows escalate) re-answers those rows with escalated MC.
+    The gate passes when, on the escalated high-variance slice, the MC
+    answers beat the unescalated closed-form/MAP baseline on ECE AND
+    NLL at matched accuracy (within 2 points) — the measured claim
+    behind the router's existence (docs/SERVING.md). Also asserts the
+    bucketed engine stayed at zero steady-state recompiles.
+
+    The serve set is the test set under Gaussian input corruption
+    (sigma 0.8): clean 8x8 digits saturate — the high-variance slice is
+    still 100% correct and extra samples only add entropy — so the
+    measurement lives where uncertainty routing matters, the
+    distribution-shift setting the Laplace literature evaluates
+    (MAP confidently wrong, MC predictive honestly spread).
+    """
+    import tempfile
+
+    from examples import data
+    from kfac_tpu.models import MLP
+    from kfac_tpu.serving import ServingConfig, ServingEngine
+
+    _log('serving_routing: training digits MLP under K-FAC')
+    (xtr, ytr), (xte, yte) = data.digits()
+    n_val = 200
+    xval, yval = jnp.asarray(xtr[-n_val:]), jnp.asarray(ytr[-n_val:])
+    xtr, ytr = jnp.asarray(xtr[:-n_val]), jnp.asarray(ytr[:-n_val])
+    xte_j, yte_np = jnp.asarray(xte), np.asarray(yte)
+    model = MLP(features=(64,), num_classes=10)
+    params = model.init(jax.random.PRNGKey(seed), xtr[:8])['params']
+    reg = kfac_tpu.register_model(model, xtr[:8])
+    kfac = kfac_tpu.KFACPreconditioner(
+        registry=reg, lr=0.1, damping=0.003,
+        factor_update_steps=5, inv_update_steps=25,
+    )
+
+    def loss_fn(p, ms, b):
+        xx, yy = b
+        logits = model.apply({'params': p}, xx)
+        onehot = jax.nn.one_hot(yy, 10)
+        return (
+            -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1)),
+            ms,
+        )
+
+    trainer = training.Trainer(
+        loss_fn=loss_fn, optimizer=optax.sgd(0.1, momentum=0.9), kfac=kfac
+    )
+    state = trainer.init(params, None)
+    bsz, n_batches = 100, len(xtr) // 100
+    for i in range(300):
+        j = (i % n_batches) * bsz
+        state, _ = trainer.step(state, (xtr[j:j + bsz], ytr[j:j + bsz]))
+
+    def apply_fn(p, xx):
+        return model.apply({'params': p}, xx)
+
+    def phi_fn(p, xx):
+        h = xx.reshape(xx.shape[0], -1)
+        return jax.nn.relu(h @ p['dense0']['kernel'] + p['dense0']['bias'])
+
+    key = jax.random.PRNGKey(seed + 29)
+    with tempfile.TemporaryDirectory() as tmp:
+        kfac_tpu.export_posterior(
+            kfac, state.kfac_state, state.params, tmp,
+            config=kfac_tpu.laplace.LaplaceConfig(mode='last_layer'),
+            overwrite=True,
+        )
+        post = kfac_tpu.load_posterior(tmp)
+    post, _ = kfac_tpu.fit_prior_precision(post, apply_fn, (xval, yval), key)
+    _log(
+        'serving_routing: fitted prior_precision '
+        f'{post.config.prior_precision:g}'
+    )
+
+    sigma = 0.8
+    xte_shift = xte_j + sigma * jax.random.normal(
+        jax.random.PRNGKey(seed + 5), xte_j.shape)
+
+    # threshold at the 80th percentile of the closed-form max-class
+    # variance: the top ~20% most-uncertain shifted rows escalate to MC
+    var = np.asarray(
+        post.linearized_variance(phi_fn(post.params, xte_shift)))
+    thr = float(np.quantile(var.max(axis=-1), 0.8))
+    eng = ServingEngine(
+        post, apply_fn, phi_fn=phi_fn,
+        config=ServingConfig(
+            bucket_granularity=64, max_batch=512, n_samples=8,
+            escalated_n_samples=32, variance_threshold=thr,
+            warmup_batches=(len(xte_j),),
+        ),
+    )
+    eng.warmup(x_spec=xte_shift[:1], key=key)
+    res = eng.serve(xte_shift, key=key, path='auto')
+    recompiles = eng.recompiles_after_warmup()
+    eng.close()
+
+    mask = np.asarray(res.escalated)
+    probs_base = np.asarray(
+        jax.nn.softmax(apply_fn(post.params, xte_shift)))
+    probs_routed = np.asarray(res.probs)
+    y_hi = yte_np[mask]
+    base_hi, mc_hi = probs_base[mask], probs_routed[mask]
+    base_acc = float((base_hi.argmax(-1) == y_hi).mean())
+    mc_acc = float((mc_hi.argmax(-1) == y_hi).mean())
+    out = {
+        'gate': 'serving_routing',
+        'shift_sigma': sigma,
+        'variance_threshold': round(thr, 6),
+        'n_test': int(len(yte_np)),
+        'n_escalated': int(mask.sum()),
+        'recompiles_after_warmup': int(recompiles),
+        'baseline_acc': round(base_acc, 4),
+        'escalated_acc': round(mc_acc, 4),
+        'baseline_nll': round(_nll(base_hi, y_hi), 4),
+        'escalated_nll': round(_nll(mc_hi, y_hi), 4),
+        'baseline_ece': round(_ece(base_hi, y_hi), 4),
+        'escalated_ece': round(_ece(mc_hi, y_hi), 4),
+    }
+    out['passed'] = bool(
+        out['n_escalated'] > 0
+        and recompiles == 0
+        and out['escalated_nll'] <= out['baseline_nll']
+        and out['escalated_ece'] <= out['baseline_ece']
+        and abs(mc_acc - base_acc) <= 0.02
+    )
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def run_lora_gate(seed: int = 0, loss_target: float = 0.2) -> dict:
     """Frozen-backbone LoRA fine-tune (examples/finetune_lora.py) must
     reach its loss target: the mask + LoRA-unit path trains end to end,
@@ -396,6 +531,7 @@ def run_lora_gate(seed: int = 0, loss_target: float = 0.2) -> dict:
 GATES = {
     'laplace_calibration': run_calibration_gate,
     'lora_finetune': run_lora_gate,
+    'serving_routing': run_serving_routing_gate,
 }
 
 
